@@ -1,0 +1,71 @@
+"""rocm_apex_tpu — a TPU-native training-utilities framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capabilities of Apex
+(reference: abhinavvishnu/rocm-apex): automatic mixed precision with
+O0–O5 policy levels and dynamic loss scaling, fused optimizers, fused
+layers (LayerNorm, scaled-masked softmax, dense/MLP, attention,
+softmax-cross-entropy, sync/group batch norm), data-parallel gradient
+reduction, and Megatron-style tensor/pipeline parallelism — all
+redesigned TPU-first:
+
+* precision is a functional *policy* threaded through modules instead of
+  monkey-patched op registries (reference: apex/amp/amp.py:75-198);
+* the kernel layer is Pallas/Mosaic instead of CUDA/HIP (reference:
+  csrc/, apex/contrib/csrc/);
+* the communication backend is XLA collectives (psum / all_gather /
+  ppermute / psum_scatter) over `jax.sharding.Mesh` axes instead of
+  NCCL/RCCL process groups (reference: apex/parallel/distributed.py).
+
+Subpackage map (mirrors the reference's public surface, SURVEY.md §1):
+
+    amp             precision policies O0–O5 + loss scaling
+    optimizers      fused Adam/LAMB/SGD/NovoGrad/Adagrad (+ mixed-precision LAMB)
+    normalization   FusedLayerNorm / MixedFusedLayerNorm
+    mlp, fused_dense fused dense/MLP modules
+    parallel        DistributedDataParallel-equivalent, SyncBatchNorm, LARC
+    transformer     parallel_state ("mpu"), tensor_parallel, pipeline_parallel
+    contrib         xentropy, flash/fused attention, transducer, ASP sparsity,
+                    group BN, ZeRO-style distributed optimizers
+    ops             the Pallas kernel layer (shared by everything above)
+    models          flax reference models (ResNet, DCGAN, GPT, BERT)
+"""
+
+import logging as _logging
+
+__version__ = "0.1.0"
+
+
+class _RankInfoFormatter(_logging.Formatter):
+    """Rank-aware log formatter.
+
+    Injects the (tp, pp, dp) rank triple into every record, mirroring the
+    reference's RankInfoFormatter (reference: apex/__init__.py:31-45,
+    apex/transformer/parallel_state.py:169). On a single-controller JAX
+    program ranks come from the active parallel_state mesh if initialized.
+    """
+
+    def format(self, record):
+        from rocm_apex_tpu.transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            record.rank_info = parallel_state.get_rank_info()
+        else:
+            record.rank_info = "(-, -, -)"
+        return super().format(record)
+
+
+def _get_logger():
+    logger = _logging.getLogger(__name__)
+    if not logger.handlers:
+        handler = _logging.StreamHandler()
+        handler.setFormatter(
+            _RankInfoFormatter(
+                "%(asctime)s - PID:%(process)d - rank:%(rank_info)s - %(name)s - %(levelname)s - %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+logger = _get_logger()

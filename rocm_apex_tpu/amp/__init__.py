@@ -1,0 +1,60 @@
+"""Automatic mixed precision for TPU — policy levels O0–O5.
+
+Public surface mirrors the reference `apex.amp`
+(reference: apex/amp/__init__.py): `initialize`, `scale_loss`,
+`state_dict`/`load_state_dict`, the function decorators, plus the
+TPU-native functional pieces (`LossScaler`, `AmpState`, `unscale_grads`,
+`update_scale`, `skip_step`).
+"""
+
+from rocm_apex_tpu.amp.amp import (
+    bfloat16_function,
+    current_policy,
+    disable_casts,
+    float_function,
+    half_function,
+    init,
+    policy_function,
+    promote_function,
+    register_bfloat16_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
+from rocm_apex_tpu.amp.frontend import (
+    AmpError,
+    Properties,
+    build_policy,
+    initialize,
+    load_state_dict,
+    opt_levels,
+    state_dict,
+)
+from rocm_apex_tpu.amp.handle import (
+    AmpState,
+    master_params,
+    scale_loss,
+    skip_step,
+    unscale_grads,
+    update_scale,
+)
+from rocm_apex_tpu.amp._process_optimizer import (
+    MasterWeightsState,
+    process_optimizer,
+    with_master_weights,
+)
+from rocm_apex_tpu.amp.scaler import LossScaler, ScalerState, all_finite
+
+__all__ = [
+    "initialize", "build_policy", "Properties", "opt_levels", "AmpError",
+    "state_dict", "load_state_dict",
+    "AmpState", "scale_loss", "unscale_grads", "update_scale", "skip_step",
+    "master_params",
+    "LossScaler", "ScalerState", "all_finite",
+    "process_optimizer", "with_master_weights", "MasterWeightsState",
+    "init", "current_policy", "disable_casts",
+    "half_function", "bfloat16_function", "float_function",
+    "promote_function", "policy_function",
+    "register_half_function", "register_bfloat16_function",
+    "register_float_function", "register_promote_function",
+]

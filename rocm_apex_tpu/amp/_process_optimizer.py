@@ -1,0 +1,94 @@
+"""Master-weight optimizer wrapping.
+
+TPU-native redesign of the reference's `_process_optimizer`
+(reference: apex/amp/_process_optimizer.py). The reference monkey-patches
+an optimizer *instance*, lazily swapping fp16 params for fresh fp32
+masters inside `param_groups` (:28-90) and copying masters back to the
+model with one fused `multi_tensor_scale` launch (:14-25). Here the same
+capability is an optax gradient-transformation wrapper:
+
+* `with_master_weights(tx)` — holds an fp32 master copy of the params in
+  its state; incoming grads are cast to fp32, the inner transform updates
+  the masters, and the emitted updates are exactly
+  ``cast(new_master, param_dtype) - params`` so that
+  `optax.apply_updates` reproduces the reference's master→model copy.
+  (The subtraction and add cancel exactly: both sides are the same
+  low-precision value, so `params + (q - params)` with q,params identical
+  dtype is exact for the IEEE formats used here when computed in fp32 —
+  we compute the delta in fp32 and rely on apply_updates' dtype cast.)
+
+Use `amp.initialize(..., optimizer=tx)` or wrap explicitly.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["with_master_weights", "process_optimizer", "MasterWeightsState"]
+
+
+class MasterWeightsState(NamedTuple):
+    master: Any  # fp32 master params
+    inner: Any  # inner transform state
+
+
+def _to_f32(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+def with_master_weights(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Wrap `tx` to update fp32 masters and emit low-precision param deltas.
+
+    Semantics of `lazy_init_with_master_weights` +
+    `post_backward_with_master_weights`
+    (reference: apex/amp/_process_optimizer.py:28-90,161-207): the inner
+    optimizer only ever sees fp32 params and fp32 grads; the model params
+    receive the rounded master values each step.
+    """
+
+    def init_fn(params):
+        master = _to_f32(params)
+        return MasterWeightsState(master=master, inner=tx.init(master))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("with_master_weights requires params in update()")
+        grads32 = _to_f32(updates)
+        inner_updates, inner_state = tx.update(grads32, state.inner, state.master)
+        new_master = optax.apply_updates(state.master, inner_updates)
+
+        def delta(m, p):
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                # master→model copy, expressed as an additive update kept in
+                # fp32: optax.apply_updates promotes p + delta to fp32, giving
+                # exactly round(master) after its final cast back to p.dtype
+                # (reference: _process_optimizer.py:14-25).
+                q = m.astype(p.dtype)
+                return q.astype(jnp.float32) - p.astype(jnp.float32)
+            return m - p
+
+        new_updates = jax.tree_util.tree_map(delta, new_master, params)
+        return new_updates, MasterWeightsState(master=new_master, inner=inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def process_optimizer(tx: optax.GradientTransformation, policy) -> optax.GradientTransformation:
+    """Apply the policy's optimizer-side behavior to an optax transform.
+
+    With ``policy.master_weights`` the transform is wrapped with fp32
+    master management; otherwise grads are still cast to fp32 before the
+    inner update when the model runs in low precision, matching the
+    reference's `post_backward_models_are_masters` path
+    (reference: apex/amp/_process_optimizer.py:93-140).
+    """
+    if policy.master_weights:
+        return with_master_weights(tx)
+    return tx

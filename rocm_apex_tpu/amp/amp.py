@@ -1,0 +1,161 @@
+"""Function-level precision casting: the decorator/registry API.
+
+The reference patches `torch` / `torch.Tensor` / `torch.nn.functional` in
+place to insert casts (reference: apex/amp/amp.py:75-198) and offers
+`half_function` / `float_function` / `promote_function` decorators for
+user functions (amp.py:29-44). JAX has no mutable op registry — and needs
+none: casting is explicit dataflow. This module provides the decorator
+half of the API with identical semantics, driven by the *active policy*:
+
+* `half_function(fn)`     — run fn with floating args cast to fp16
+* `bfloat16_function(fn)` — ... cast to bf16 (ROCm-fork extension)
+* `float_function(fn)`    — ... cast to fp32 (the "blacklist" behavior)
+* `promote_function(fn)`  — args promoted to the widest floating dtype
+  (the reference's multi-arg type-promotion wrapper, apex/amp/wrap.py)
+
+Decorated functions are no-ops until a policy with ``cast_functions=True``
+(O1/O4) is activated via `amp.init(policy)` / `amp.initialize(...)`, and
+inside a `disable_casts()` scope (the reference's ctx manager at
+handle.py:163-167).
+
+Weight-cast caching (reference: apex/amp/utils.py:54-130) is unnecessary:
+XLA CSEs repeated casts of the same array inside a jitted step, which is
+the compiler-native version of the reference's cache.
+"""
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "init",
+    "current_policy",
+    "disable_casts",
+    "half_function",
+    "bfloat16_function",
+    "float_function",
+    "promote_function",
+    "register_half_function",
+    "register_bfloat16_function",
+    "register_float_function",
+    "register_promote_function",
+]
+
+# Module-level active policy: the analogue of the reference's `_amp_state`
+# singleton holding the active handle (apex/amp/_amp_state.py). This is
+# *static* configuration (dtypes), never traced state — safe under jit.
+_active_policy = None
+_casts_disabled = False
+
+
+def init(policy=None, enabled: bool = True):
+    """Activate `policy` for decorator-based casting (reference amp.init,
+    apex/amp/amp.py:75-198). Called by `amp.initialize` for O1/O4."""
+    global _active_policy
+    _active_policy = policy if enabled else None
+    return policy
+
+
+def current_policy():
+    return _active_policy
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Scope within which decorated functions run uncast
+    (reference: apex/amp/handle.py:163-167)."""
+    global _casts_disabled
+    prev = _casts_disabled
+    _casts_disabled = True
+    try:
+        yield
+    finally:
+        _casts_disabled = prev
+
+
+def _casting_active():
+    p = _active_policy
+    return p is not None and p.enabled and p.cast_functions and not _casts_disabled
+
+
+def _cast_args(dtype, args, kwargs):
+    def c(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(c, (args, kwargs))
+
+
+def _make_cast_decorator(target_dtype: Optional[str]):
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _casting_active():
+                return fn(*args, **kwargs)
+            if target_dtype == "policy":
+                dtype = _active_policy.cast_functions_dtype
+            else:
+                dtype = target_dtype
+            cargs, ckwargs = _cast_args(dtype, args, kwargs)
+            return fn(*cargs, **ckwargs)
+
+        return wrapper
+
+    return decorator
+
+
+# `half_function` always casts to fp16, matching the reference's hard-coded
+# `utils.maybe_half` (reference: apex/amp/amp.py:29-31) — only the cast
+# *lists* switch dtype per level. Use `policy_function` to follow the active
+# policy's compute dtype (fp16 under O1, bf16 under O4).
+half_function = _make_cast_decorator(jnp.float16)
+bfloat16_function = _make_cast_decorator(jnp.bfloat16)
+float_function = _make_cast_decorator(jnp.float32)
+# Cast to whatever the active policy's compute dtype is (what a function
+# on the fp16/bf16 whitelist effectively receives under O1/O4).
+policy_function = _make_cast_decorator("policy")
+
+
+def promote_function(fn):
+    """Promote all floating args to the widest floating dtype among them
+    (reference promote/sequence_promote wrappers, apex/amp/wrap.py)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _casting_active():
+            return fn(*args, **kwargs)
+        leaves = [
+            x
+            for x in jax.tree_util.tree_leaves((args, kwargs))
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        ]
+        if not leaves:
+            return fn(*args, **kwargs)
+        widest = functools.reduce(jnp.promote_types, (x.dtype for x in leaves))
+        cargs, ckwargs = _cast_args(widest, args, kwargs)
+        return fn(*cargs, **ckwargs)
+
+    return wrapper
+
+
+# Registry-style aliases matching the reference's module-function API
+# (reference: apex/amp/amp.py:48-71). In JAX there is no module object to
+# patch, so these take and return the function directly.
+def register_half_function(module, name):
+    setattr(module, name, half_function(getattr(module, name)))
+
+
+def register_bfloat16_function(module, name):
+    setattr(module, name, bfloat16_function(getattr(module, name)))
+
+
+def register_float_function(module, name):
+    setattr(module, name, float_function(getattr(module, name)))
+
+
+def register_promote_function(module, name):
+    setattr(module, name, promote_function(getattr(module, name)))

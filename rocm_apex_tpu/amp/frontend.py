@@ -1,0 +1,418 @@
+"""Precision-policy frontend: opt-levels O0–O5.
+
+TPU-native redesign of the reference amp frontend
+(reference: apex/amp/frontend.py:7-254). The reference mutates torch op
+registries and module dtypes in place; here a `Properties` policy object is
+*data* that threads through pure functions:
+
+* ``cast_model_dtype``     — dtype model params are stored/cast to
+  (reference ``cast_model_type``; O2 fp16 / O3 fp16 / O5 bf16).
+* ``cast_functions``       — whether compute-level casting is active
+  (reference ``patch_torch_functions``; O1/O4). In JAX nothing is patched:
+  modules and the `half_function`/`bfloat16_function` decorators consult
+  the policy (see amp/amp.py).
+* ``cast_functions_dtype`` — the compute dtype for O1 (fp16) / O4 (bf16)
+  (reference ``patch_torch_functions_type``).
+* ``keep_batchnorm_fp32``  — exempt batch-norm leaves from the model cast.
+* ``master_weights``       — keep an fp32 master copy in optimizer state
+  (reference builds fp32 masters lazily, apex/amp/_process_optimizer.py:28-90).
+* ``loss_scale``           — float or "dynamic"
+  (bf16 levels O4/O5 default to 1: same exponent range as fp32, so no
+  scaling needed — reference frontend.py:207-246).
+
+O4/O5 (bf16) are the *primary* TPU paths; fp16 levels exist for parity.
+"""
+
+import warnings
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.utils.tree import is_batchnorm_path, tree_cast
+
+__all__ = [
+    "Properties",
+    "opt_levels",
+    "build_policy",
+    "initialize",
+    "state_dict",
+    "load_state_dict",
+    "AmpError",
+]
+
+
+class AmpError(ValueError):
+    pass
+
+
+def warn_or_err(msg, strict=True):
+    # Mirrors the behavior switch in the reference's `warn_or_err`
+    # (reference: apex/amp/_amp_state.py): hard error by default.
+    if strict:
+        raise AmpError(msg)
+    warnings.warn(msg)
+
+
+_OPTION_NAMES = (
+    "enabled",
+    "opt_level",
+    "cast_model_dtype",
+    "cast_functions",
+    "cast_functions_dtype",
+    "keep_batchnorm_fp32",
+    "master_weights",
+    "loss_scale",
+)
+
+
+class Properties:
+    """Policy option struct with per-option consistency checks.
+
+    Same role and validation semantics as the reference `Properties`
+    (reference: apex/amp/frontend.py:7-113), rebuilt as plain data: routes
+    attribute sets through checks so inconsistent combinations
+    (e.g. master_weights with O1/O4) raise/warn.
+    """
+
+    def __init__(self):
+        self.__dict__["options"] = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_dtype": None,
+            "cast_functions": False,
+            "cast_functions_dtype": None,
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+        }
+
+    def _update_options_dict(self, new_options):
+        for k, v in new_options.items():
+            if k not in self.options:
+                raise AmpError(f"Tried to set unexpected option {k}")
+            self.options[k] = v
+
+    def __getattr__(self, name):
+        options = self.__dict__.get("options")
+        if options is not None and name in options:
+            return options[name]
+        raise AttributeError(f"'Properties' object has no attribute '{name}'")
+
+    def __setattr__(self, name, value):
+        if name not in self.options:
+            super().__setattr__(name, value)
+            return
+        if name == "cast_model_dtype":
+            if self.opt_level in ("O1", "O4") and value not in (None, False):
+                if value != jnp.float32:
+                    warn_or_err(
+                        "O1/O4 insert casts around functions rather than model "
+                        "weights; with O1/O4 the model weights should remain "
+                        "FP32. Use opt_level='O2'/'O3' (fp16) or 'O5' (bf16) "
+                        f"to cast the model. cast_model_dtype was {value}"
+                    )
+            self.options[name] = value
+        elif name == "cast_functions":
+            if self.opt_level not in ("O1", "O4") and value:
+                warn_or_err(
+                    "cast_functions=True should only be set by selecting "
+                    "opt_level='O1' or 'O4'."
+                )
+            self.options[name] = value
+        elif name == "cast_functions_dtype":
+            if self.opt_level not in ("O1", "O4") and value is not None:
+                warn_or_err(
+                    "cast_functions_dtype should only be set by selecting "
+                    "opt_level='O1' or 'O4'."
+                )
+            elif self.opt_level == "O1" and value != jnp.float16:
+                warn_or_err("cast_functions_dtype must be float16 for opt_level='O1'.")
+            elif self.opt_level == "O4" and value != jnp.bfloat16:
+                warn_or_err("cast_functions_dtype must be bfloat16 for opt_level='O4'.")
+            else:
+                self.options[name] = value
+        elif name == "keep_batchnorm_fp32":
+            if self.opt_level in ("O1", "O4") and value is not None:
+                warn_or_err(
+                    "With opt_level O1/O4 batch-norm runs in FP32 via the "
+                    "policy cast lists, so keep_batchnorm_fp32 should be None. "
+                    f"keep_batchnorm_fp32 was {value}"
+                )
+            if value == "False":
+                value = False
+            elif value == "True":
+                value = True
+            if value not in (True, False, None):
+                raise AmpError(
+                    "keep_batchnorm_fp32 must be a bool, the string 'True' or "
+                    f"'False', or None; found {value}"
+                )
+            self.options[name] = value
+        elif name == "master_weights":
+            if self.opt_level in ("O1", "O4") and value is not None:
+                warn_or_err(
+                    "master_weights does not make sense with O1/O4 — model "
+                    "weights are already FP32."
+                )
+            self.options[name] = value
+        elif name == "loss_scale":
+            self.options[name] = value if value == "dynamic" else float(value)
+        else:
+            self.options[name] = value
+
+    # -- derived views used throughout the framework --------------------
+
+    @property
+    def compute_dtype(self):
+        """Dtype matmul-heavy compute should run in under this policy."""
+        if self.cast_functions and self.cast_functions_dtype is not None:
+            return self.cast_functions_dtype
+        if self.cast_model_dtype not in (None, False):
+            return self.cast_model_dtype
+        return jnp.float32
+
+    @property
+    def param_dtype(self):
+        """Dtype model params are stored in under this policy."""
+        if self.cast_model_dtype not in (None, False):
+            return self.cast_model_dtype
+        return jnp.float32
+
+    def __repr__(self):
+        opts = ", ".join(f"{k}={v!r}" for k, v in self.options.items())
+        return f"Properties({opts})"
+
+
+class O0:
+    brief = "O0: Pure FP32 training."
+
+    def __call__(self, p):
+        p.enabled = True
+        p.opt_level = "O0"
+        p.cast_model_dtype = jnp.float32
+        p.cast_functions = False
+        p.cast_functions_dtype = None
+        p.keep_batchnorm_fp32 = None
+        p.master_weights = False
+        p.loss_scale = 1.0
+        return p
+
+
+class O1:
+    brief = "O1: Policy casts around functions (FP16 compute, FP32 weights)."
+
+    def __call__(self, p):
+        p.enabled = True
+        p.opt_level = "O1"
+        p.cast_model_dtype = None
+        p.cast_functions = True
+        p.cast_functions_dtype = jnp.float16
+        p.keep_batchnorm_fp32 = None
+        p.master_weights = None
+        p.loss_scale = "dynamic"
+        return p
+
+
+class O2:
+    brief = "O2: FP16 training with FP32 batchnorm and FP32 master weights."
+
+    def __call__(self, p):
+        p.enabled = True
+        p.opt_level = "O2"
+        p.cast_model_dtype = jnp.float16
+        p.cast_functions = False
+        p.cast_functions_dtype = None
+        p.keep_batchnorm_fp32 = True
+        p.master_weights = True
+        p.loss_scale = "dynamic"
+        return p
+
+
+class O3:
+    brief = "O3: Pure FP16 training."
+
+    def __call__(self, p):
+        p.enabled = True
+        p.opt_level = "O3"
+        p.cast_model_dtype = jnp.float16
+        p.cast_functions = False
+        p.cast_functions_dtype = None
+        p.keep_batchnorm_fp32 = False
+        p.master_weights = False
+        p.loss_scale = 1.0
+        return p
+
+
+class O4:
+    brief = "O4: Policy casts around functions (BF16 compute, FP32 weights)."
+
+    def __call__(self, p):
+        p.enabled = True
+        p.opt_level = "O4"
+        p.cast_model_dtype = None
+        p.cast_functions = True
+        p.cast_functions_dtype = jnp.bfloat16
+        p.keep_batchnorm_fp32 = None
+        p.master_weights = None
+        p.loss_scale = 1
+        return p
+
+
+class O5:
+    brief = "O5: BF16 training with FP32 batchnorm and FP32 master weights."
+
+    def __call__(self, p):
+        p.enabled = True
+        p.opt_level = "O5"
+        p.cast_model_dtype = jnp.bfloat16
+        p.cast_functions = False
+        p.cast_functions_dtype = None
+        p.keep_batchnorm_fp32 = True
+        p.master_weights = True
+        p.loss_scale = 1
+        return p
+
+
+opt_levels = {
+    "O0": O0(),
+    "O1": O1(),
+    "O2": O2(),
+    "O3": O3(),
+    "O4": O4(),
+    "O5": O5(),
+}
+
+
+def build_policy(
+    opt_level: str = "O1",
+    cast_model_dtype=None,
+    cast_functions=None,
+    cast_functions_dtype=None,
+    keep_batchnorm_fp32=None,
+    master_weights=None,
+    loss_scale=None,
+) -> Properties:
+    """Resolve an opt-level plus user overrides into a `Properties` policy.
+
+    Mirrors the override flow of `amp.initialize`
+    (reference: apex/amp/frontend.py:373-419): the opt-level establishes
+    defaults, then explicit keyword overrides are applied through the
+    consistency checks.
+    """
+    if opt_level not in opt_levels:
+        raise AmpError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', "
+            "'O1', 'O2', 'O3', 'O4', 'O5'. Note the use of the letter O, not "
+            "the number zero."
+        )
+    p = opt_levels[opt_level](Properties())
+    overrides = {
+        "cast_model_dtype": cast_model_dtype,
+        "cast_functions": cast_functions,
+        "cast_functions_dtype": cast_functions_dtype,
+        "keep_batchnorm_fp32": keep_batchnorm_fp32,
+        "master_weights": master_weights,
+        "loss_scale": loss_scale,
+    }
+    for k, v in overrides.items():
+        if v is not None:
+            setattr(p, k, v)
+    return p
+
+
+def initialize(
+    params: Any,
+    optimizer=None,
+    opt_level: str = "O1",
+    num_losses: int = 1,
+    is_batchnorm: Optional[Callable] = None,
+    verbosity: int = 1,
+    **overrides,
+):
+    """Apply an amp policy to a param pytree (+ optionally an optax optimizer).
+
+    Functional analogue of `amp.initialize`
+    (reference: apex/amp/frontend.py:258-425 and apex/amp/_initialize.py):
+
+    * casts the param pytree to ``cast_model_dtype``, exempting batch-norm
+      leaves when ``keep_batchnorm_fp32`` (reference keeps `_BatchNorm`
+      modules fp32, _initialize.py:176-182);
+    * wraps the optax optimizer with master-weight management + loss-scaled
+      update skipping (reference patches optimizer instances in place,
+      _process_optimizer.py);
+    * builds ``num_losses`` independent `LossScaler` configs
+      (reference: _initialize.py:227-231).
+
+    Returns ``(params, optimizer, amp_state)`` where ``amp_state`` is an
+    `AmpState` carrying the policy and scaler states; it is a pytree and can
+    live inside a jitted train state.
+    """
+    from rocm_apex_tpu.amp.handle import AmpState
+    from rocm_apex_tpu.amp.scaler import LossScaler
+
+    policy = build_policy(opt_level, **overrides)
+    if verbosity:
+        from rocm_apex_tpu import logger
+
+        logger.info("amp.initialize: opt_level=%s → %r", opt_level, policy)
+
+    if policy.cast_model_dtype not in (None, False):
+        keep = None
+        if policy.keep_batchnorm_fp32:
+            keep = is_batchnorm or is_batchnorm_path
+        params = tree_cast(params, policy.cast_model_dtype, keep_fp32_predicate=keep)
+
+    # Activate (or deactivate) the decorator-based casting path — the
+    # analogue of the reference's amp_init patching for O1/O4
+    # (_initialize.py:233-237). Unconditional so re-initializing with a
+    # non-casting level clears any previously active policy.
+    from rocm_apex_tpu.amp import amp as _amp_mod
+
+    _amp_mod.init(policy if policy.cast_functions else None)
+
+    scaler = LossScaler(policy.loss_scale)
+    amp_state = AmpState(
+        policy=policy,
+        scaler=scaler,
+        scaler_states=tuple(scaler.init() for _ in range(num_losses)),
+    )
+
+    if optimizer is not None:
+        from rocm_apex_tpu.amp._process_optimizer import process_optimizer
+
+        optimizer = process_optimizer(optimizer, policy)
+
+    return params, optimizer, amp_state
+
+
+def state_dict(amp_state) -> dict:
+    """Serializable scaler state: `{loss_scaler0: {loss_scale, unskipped}, …}`.
+
+    Same schema as the reference (reference: apex/amp/frontend.py:428-437).
+    """
+    out = {}
+    for i, s in enumerate(amp_state.scaler_states):
+        out[f"loss_scaler{i}"] = {
+            "loss_scale": float(s.loss_scale),
+            "unskipped": int(s.unskipped),
+        }
+    return out
+
+
+def load_state_dict(amp_state, state: dict):
+    """Restore scaler states saved by `state_dict` (reference frontend.py:440-467)."""
+    if len(state) != len(amp_state.scaler_states):
+        warnings.warn(
+            f"Loading state_dict containing {len(state)} entries, but "
+            f"AmpState has {len(amp_state.scaler_states)} scalers"
+        )
+    new_states = list(amp_state.scaler_states)
+    for key, value in state.items():
+        i = int(key.replace("loss_scaler", ""))
+        if i < len(new_states):
+            new_states[i] = new_states[i]._replace(
+                loss_scale=jnp.asarray(value["loss_scale"], jnp.float32),
+                unskipped=jnp.asarray(value["unskipped"], jnp.int32),
+            )
+    return amp_state.replace(scaler_states=tuple(new_states))

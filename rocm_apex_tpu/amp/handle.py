@@ -1,0 +1,129 @@
+"""Amp state + the loss-scaling training flow.
+
+Functional redesign of the reference's `scale_loss` context manager and
+`AmpHandle` (reference: apex/amp/handle.py:16-252). The reference's
+context manager mutates optimizers on exit and patches `optimizer.step`
+to skip on overflow (handle.py:128-154); in JAX the same sequence is a
+pure dataflow:
+
+    scaled = amp.scale_loss(loss, amp_state)                 # fwd
+    grads  = jax.grad(...)                                   # bwd on scaled loss
+    grads, found_inf = amp.unscale_grads(grads, amp_state)   # fused unscale+probe
+    amp_state, skip  = amp.update_scale(amp_state, found_inf)
+    new = amp.skip_step(skip, new_tree, old_tree)            # lax.cond analogue
+
+`AmpState` is a pytree (scaler states are traced; policy/scaler config are
+static aux data) so it lives inside a jitted train state.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "AmpState",
+    "scale_loss",
+    "unscale_grads",
+    "update_scale",
+    "skip_step",
+    "master_params",
+]
+
+
+class AmpState:
+    """Carries the policy (static), scaler config (static) and per-loss
+    scaler states (traced pytree leaves).
+
+    The analogue of the reference's global `_amp_state` singleton
+    (reference: apex/amp/_amp_state.py) — but explicit and functional.
+    """
+
+    def __init__(self, policy, scaler, scaler_states):
+        self.policy = policy
+        self.scaler = scaler
+        self.scaler_states = tuple(scaler_states)
+
+    def replace(self, **kw):
+        d = dict(policy=self.policy, scaler=self.scaler, scaler_states=self.scaler_states)
+        d.update(kw)
+        return AmpState(**d)
+
+    @property
+    def loss_scale(self):
+        return self.scaler_states[0].loss_scale
+
+    def __repr__(self):
+        return (
+            f"AmpState(opt_level={self.policy.opt_level}, "
+            f"num_losses={len(self.scaler_states)})"
+        )
+
+
+def _amp_state_flatten(s):
+    return (s.scaler_states,), (s.policy, s.scaler)
+
+
+def _amp_state_unflatten(aux, children):
+    policy, scaler = aux
+    return AmpState(policy, scaler, children[0])
+
+
+jax.tree_util.register_pytree_node(AmpState, _amp_state_flatten, _amp_state_unflatten)
+
+
+def scale_loss(loss, amp_state: AmpState, loss_id: int = 0):
+    """Return `loss.float() * current_scale` (reference: handle.py:113).
+
+    If amp is disabled this is the identity (reference `NoOpHandle`,
+    handle.py:254-281).
+    """
+    if not amp_state.policy.enabled:
+        return loss
+    return amp_state.scaler.scale(amp_state.scaler_states[loss_id], loss)
+
+
+def unscale_grads(grads, amp_state: AmpState, loss_id: int = 0, stashed=None):
+    """Unscale grads to fp32 and probe for inf/nan in one pass.
+
+    Returns ``(grads_fp32, found_inf)``. With ``stashed`` (fp32 grads from
+    an earlier backward) performs the axpby accumulate-merge instead
+    (reference: apex/amp/_process_optimizer.py:161-207).
+    """
+    scaler, state = amp_state.scaler, amp_state.scaler_states[loss_id]
+    if stashed is not None:
+        return scaler.unscale_with_stashed(state, stashed, grads)
+    return scaler.unscale(state, grads)
+
+
+def update_scale(amp_state: AmpState, found_inf, loss_id: int = 0):
+    """Advance the dynamic scale; returns ``(amp_state, should_skip)``."""
+    scaler = amp_state.scaler
+    states = list(amp_state.scaler_states)
+    states[loss_id], should_skip = scaler.update(states[loss_id], found_inf)
+    return amp_state.replace(scaler_states=tuple(states)), should_skip
+
+
+def skip_step(should_skip, new_tree: Any, old_tree: Any) -> Any:
+    """Select old state when the step must be skipped.
+
+    The jit-safe analogue of patching `optimizer.step` to a no-op
+    (reference: handle.py:128-154). `jnp.where` keeps both branches
+    fusible; XLA turns this into selects, which on TPU is cheaper than
+    divergent control flow.
+    """
+    return jax.tree_util.tree_map(
+        lambda new, old: jnp.where(should_skip, old, new), new_tree, old_tree
+    )
+
+
+def master_params(opt_state):
+    """Yield fp32 master params from a processed optimizer state
+    (reference: apex/amp/_amp_state.py:60-69)."""
+    from rocm_apex_tpu.amp._process_optimizer import MasterWeightsState
+
+    for s in jax.tree_util.tree_leaves(
+        opt_state, is_leaf=lambda x: isinstance(x, MasterWeightsState)
+    ):
+        if isinstance(s, MasterWeightsState):
+            yield from jax.tree_util.tree_leaves(s.master)

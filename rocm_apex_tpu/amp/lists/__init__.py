@@ -1,0 +1,1 @@
+from rocm_apex_tpu.amp.lists import functional_overrides, jnp_overrides

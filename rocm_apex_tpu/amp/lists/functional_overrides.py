@@ -1,0 +1,14 @@
+"""Alias namespace for parity with the reference's per-namespace lists
+(reference: apex/amp/lists/functional_overrides.py). In JAX there is a
+single op namespace, so this re-exports the canonical lists."""
+
+from rocm_apex_tpu.amp.lists.jnp_overrides import (  # noqa: F401
+    BANNED_FUNCS,
+    BFLOAT16_FUNCS,
+    CASTS,
+    FP16_FUNCS,
+    FP32_FUNCS,
+    SEQUENCE_CASTS,
+    is_fp32_op,
+    is_low_precision_op,
+)

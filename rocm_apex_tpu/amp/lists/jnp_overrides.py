@@ -1,0 +1,72 @@
+"""Cast lists: which op families run in low precision vs fp32.
+
+The TPU analogue of the reference's per-namespace cast lists
+(reference: apex/amp/lists/torch_overrides.py:7-48,
+functional_overrides.py:17-37). In JAX the lists are *data consumed by
+module implementations and the policy decorators*, not a patch target:
+every fused module in this framework consults `is_low_precision_op` /
+`is_fp32_op` to decide its compute dtype under an O1/O4 policy.
+
+Low-precision list = MXU-friendly ops (matmul/conv families — exactly the
+Tensor-Core list in the reference, torch_overrides.py:7-27 plus the bf16
+list at :29-48). FP32 list = reductions and numerically-sensitive ops
+(softmax/norm/loss families, torch_overrides.py:50-82).
+"""
+
+# MXU-eligible ops: run in policy compute dtype (fp16 under O1, bf16 under O4).
+FP16_FUNCS = [
+    "conv1d", "conv2d", "conv3d", "conv_transpose",
+    "dot", "dot_general", "matmul", "einsum", "tensordot",
+    "conv_general_dilated",
+    "linear", "dense",
+    "attention", "scaled_dot_product_attention",
+]
+
+# The ROCm fork's bf16 list mirrors the fp16 one (torch_overrides.py:29-48).
+BFLOAT16_FUNCS = list(FP16_FUNCS)
+
+# Numerically-sensitive ops: always fp32 inputs under O1/O4.
+FP32_FUNCS = [
+    "softmax", "log_softmax", "logsumexp",
+    "layer_norm", "group_norm", "batch_norm", "normalize", "rms_norm",
+    "cross_entropy", "nll_loss", "l1_loss", "mse_loss", "kl_div",
+    "smooth_l1_loss", "cosine_similarity",
+    "exp", "expm1", "log", "log1p", "log2", "log10",
+    "pow", "rsqrt", "sqrt", "reciprocal",
+    "sum", "mean", "prod", "cumsum", "cumprod", "var", "std", "norm",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "erf", "erfc", "erfinv", "gelu",
+]
+
+# Multi-arg promotion (widest dtype wins) — reference CASTS list.
+CASTS = [
+    "add", "subtract", "multiply", "divide", "true_divide",
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "maximum", "minimum", "atan2", "hypot", "nextafter",
+    "where",
+]
+
+# Sequence promotion (cat/stack in the reference).
+SEQUENCE_CASTS = ["concatenate", "stack", "hstack", "vstack", "dstack"]
+
+# Ops that error under mixed precision in the reference (BANNED_FUNCS,
+# functional_overrides.py). In JAX these simply require fp32 inputs; we
+# record them so the policy layer can raise a helpful error.
+BANNED_FUNCS = [
+    ("binary_cross_entropy",
+     "amp does not work out-of-the-box with binary_cross_entropy on "
+     "low-precision logits: it requires the output of sigmoid and is "
+     "unsafe to run in fp16/bf16. Use a fused sigmoid+BCE-with-logits "
+     "formulation (optax.sigmoid_binary_cross_entropy) instead."),
+]
+
+_LOW = frozenset(FP16_FUNCS)
+_F32 = frozenset(FP32_FUNCS)
+
+
+def is_low_precision_op(name: str) -> bool:
+    return name in _LOW
+
+
+def is_fp32_op(name: str) -> bool:
+    return name in _F32

@@ -1,0 +1,172 @@
+"""Functional loss scaler.
+
+TPU-native redesign of the reference `LossScaler`
+(reference: apex/amp/scaler.py:42-226). The reference mutates a python
+object and reads a device-side overflow buffer with `.item()` (a D2H
+sync); here the scaler state is a tiny pytree carried in the train state
+so the whole unscale/check/update/skip sequence stays inside one jitted
+step — no host sync, and the skip-step is a `lax.cond` instead of the
+reference's runtime `optimizer.step` patching (apex/amp/handle.py:128-154).
+
+Constants match the reference exactly (scaler.py:47-63, 206-226):
+init_scale=2**16, scale_factor=2, scale_window=2000 unskipped steps,
+backoff ÷2 on overflow, max_loss_scale=2**24, optional min clamp.
+
+The overflow probe fuses into the unscale as a `jnp.isfinite` reduction —
+the analogue of the fused `multi_tensor_scale` kernel's noop_flag
+(reference: csrc/multi_tensor_scale_kernel.cu:30-136); see also
+ops/multi_tensor.py for the Pallas fused path.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LossScaler", "ScalerState", "all_finite"]
+
+
+class ScalerState(NamedTuple):
+    """Dynamic scaler state; a pytree of three scalars."""
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    unskipped: jnp.ndarray  # i32: consecutive non-overflow steps
+    overflows: jnp.ndarray  # i32: total skipped steps (observability)
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """True iff every element of every floating leaf is finite.
+
+    The grad-overflow probe (reference: scaler.py:6-40 python path;
+    fused path writes a noop flag in-kernel).
+    """
+    leaves = [
+        x
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finite).all()
+
+
+class LossScaler:
+    """Static scaler config; all methods are pure and jit-safe.
+
+    ``loss_scale`` is a float for static scaling or "dynamic"
+    (reference: scaler.py:47-63).
+    """
+
+    def __init__(
+        self,
+        loss_scale="dynamic",
+        init_scale=2.0**16,
+        scale_factor=2.0,
+        scale_window=2000,
+        min_loss_scale=None,
+        max_loss_scale=2.0**24,
+    ):
+        self.dynamic = loss_scale == "dynamic"
+        self._init_scale = (
+            min(max_loss_scale, init_scale) if self.dynamic else float(loss_scale)
+        )
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = max_loss_scale
+
+    def init(self) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.asarray(self._init_scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32),
+            overflows=jnp.asarray(0, jnp.int32),
+        )
+
+    # -- the four pure operations --------------------------------------
+
+    def scale(self, state: ScalerState, loss: jnp.ndarray) -> jnp.ndarray:
+        """`loss.float() * loss_scale` (reference: handle.py:113)."""
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(self, state: ScalerState, grads: Any) -> Tuple[Any, jnp.ndarray]:
+        """Unscale grads (out dtype fp32) and probe for inf/nan.
+
+        Fuses the 1/scale multiply with the finite check, like the fused
+        `multi_tensor_scale` unscale (reference: scaler.py:114-126).
+        Returns ``(unscaled_grads, found_inf)``.
+        """
+        inv = 1.0 / state.loss_scale
+
+        def _unscale(g):
+            if jnp.issubdtype(g.dtype, jnp.inexact):
+                return g.astype(jnp.float32) * inv
+            return g
+
+        unscaled = jax.tree_util.tree_map(_unscale, grads)
+        found_inf = jnp.logical_not(all_finite(unscaled))
+        return unscaled, found_inf
+
+    def unscale_with_stashed(
+        self, state: ScalerState, stashed: Any, grads: Any
+    ) -> Tuple[Any, jnp.ndarray]:
+        """out = stashed + grads/scale — the gradient-accumulation merge.
+
+        Analogue of the fused axpby path used when fp32 grads from a
+        previous backward are stashed (reference: scaler.py:160-198,
+        apex/amp/_process_optimizer.py:142-207).
+        """
+        inv = 1.0 / state.loss_scale
+        out = jax.tree_util.tree_map(
+            lambda s, g: s.astype(jnp.float32) + g.astype(jnp.float32) * inv,
+            stashed,
+            grads,
+        )
+        found_inf = jnp.logical_not(all_finite(out))
+        return out, found_inf
+
+    def update(
+        self, state: ScalerState, found_inf: jnp.ndarray
+    ) -> Tuple[ScalerState, jnp.ndarray]:
+        """Post-step scale update; returns ``(new_state, should_skip)``.
+
+        Semantics of `update_scale` (reference: scaler.py:206-226): on
+        overflow halve (clamped at min) and reset the window; after
+        `scale_window` consecutive clean steps double (clamped at max).
+        For a static scaler the scale never changes and steps are never
+        skipped (matching the reference, which only skips when dynamic).
+        """
+        if not self.dynamic:
+            return state, jnp.asarray(False)
+
+        found_inf = jnp.asarray(found_inf)
+
+        def on_overflow(s):
+            new_scale = s.loss_scale / self.scale_factor
+            if self.min_loss_scale is not None:
+                new_scale = jnp.maximum(new_scale, self.min_loss_scale)
+            return ScalerState(
+                loss_scale=new_scale,
+                unskipped=jnp.asarray(0, jnp.int32),
+                overflows=s.overflows + 1,
+            )
+
+        def on_clean(s):
+            unskipped = s.unskipped + 1
+            grow = unskipped >= self.scale_window
+            new_scale = jnp.where(
+                grow,
+                jnp.minimum(s.loss_scale * self.scale_factor, self.max_loss_scale),
+                s.loss_scale,
+            )
+            return ScalerState(
+                loss_scale=new_scale,
+                unskipped=jnp.where(grow, 0, unskipped).astype(jnp.int32),
+                overflows=s.overflows,
+            )
+
+        new_state = jax.lax.cond(found_inf, on_overflow, on_clean, state)
+        return new_state, found_inf
+
+    def loss_scale(self, state: ScalerState) -> jnp.ndarray:
+        return state.loss_scale

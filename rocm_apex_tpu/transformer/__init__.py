@@ -1,0 +1,11 @@
+"""Megatron-style model parallelism for TPU meshes.
+
+Mirrors the reference `apex.transformer` package layout
+(reference: apex/transformer/__init__.py): `parallel_state` (the "mpu"),
+`tensor_parallel`, `pipeline_parallel`, `functional` (fused softmax), and
+`amp` (model-parallel-aware grad scaler).
+"""
+
+from rocm_apex_tpu.transformer import parallel_state
+
+__all__ = ["parallel_state"]

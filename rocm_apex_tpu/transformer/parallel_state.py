@@ -1,0 +1,322 @@
+"""Model-parallel state: the TPU-native "mpu".
+
+Redesign of the reference's process-group manager
+(reference: apex/transformer/parallel_state.py:25-396). The reference
+builds NCCL process groups (data-parallel, tensor-MP, pipeline-MP,
+model = TP×PP, embedding) with TP-fastest rank mapping
+(initialize_model_parallel:58-167). On TPU there are no process groups:
+a single `jax.sharding.Mesh` with named axes ``('data', 'pipe', 'tensor')``
+plays that role, and "groups" become mesh axes that collectives
+(`psum`/`all_gather`/`ppermute`) name directly. XLA lays the axes onto
+ICI; the TP axis is innermost so TP collectives ride the fastest links —
+the same locality goal as the reference's TP-fastest rank mapping.
+
+Single-controller JAX has no "current rank" at trace time; rank-dependent
+logic lives either (a) inside `shard_map` via `lax.axis_index(axis)` or
+(b) in schedule construction via the explicit ``rank=`` arguments the
+getters accept (mirroring the reference API, which reads the implicit
+process rank).
+
+Axis names: ``data`` (DP), ``pipe`` (PP), ``tensor`` (TP). An optional
+``expert`` axis and ``context`` axis are supported for EP/SP meshes —
+capability the reference lacks (SURVEY.md §5 "long-context: limited") but
+that falls out of the mesh design.
+"""
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "initialize_model_parallel",
+    "model_parallel_is_initialized",
+    "destroy_model_parallel",
+    "get_mesh",
+    "get_data_parallel_axis_name",
+    "get_tensor_model_parallel_axis_name",
+    "get_pipeline_model_parallel_axis_name",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_world_size",
+    "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_rank",
+    "get_data_parallel_rank",
+    "is_pipeline_first_stage",
+    "is_pipeline_last_stage",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "get_virtual_pipeline_model_parallel_world_size",
+    "get_pipeline_model_parallel_split_rank",
+    "get_num_layers",
+    "get_rank_info",
+]
+
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+TENSOR_AXIS = "tensor"
+CONTEXT_AXIS = "context"
+EXPERT_AXIS = "expert"
+
+# Module-level state, mirroring the reference's group globals
+# (reference: parallel_state.py:25-50).
+_MESH: Optional[Mesh] = None
+_TENSOR_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_DATA_PARALLEL_WORLD_SIZE: Optional[int] = None
+_CONTEXT_PARALLEL_WORLD_SIZE: int = 1
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_PIPELINE_MODEL_PARALLEL_SPLIT_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    *,
+    context_parallel_size_: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and install the global device mesh.
+
+    Validation and factoring semantics follow the reference
+    (reference: parallel_state.py:58-167): world size must be divisible by
+    tp*pp (*cp here), data-parallel size is the quotient, and virtual
+    pipelining requires pp ≥ 2.
+
+    Returns the `jax.sharding.Mesh` with axes (data, pipe, [context,]
+    tensor), TP innermost.
+    """
+    global _MESH, _TENSOR_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_WORLD_SIZE, _DATA_PARALLEL_WORLD_SIZE
+    global _CONTEXT_PARALLEL_WORLD_SIZE
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+    if devices is None:
+        devices = jax.devices()
+    world_size = len(devices)
+    tp, pp, cp = (
+        tensor_model_parallel_size_,
+        pipeline_model_parallel_size_,
+        context_parallel_size_,
+    )
+    model_size = tp * pp * cp
+    if world_size % model_size != 0:
+        raise RuntimeError(
+            f"world size ({world_size}) is not divisible by tensor parallel "
+            f"size ({tp}) x pipeline parallel size ({pp}) x context parallel "
+            f"size ({cp})"
+        )
+    dp = world_size // model_size
+
+    if virtual_pipeline_model_parallel_size_ is not None:
+        if pp <= 2:
+            raise RuntimeError(
+                "pipeline-model-parallel size should be greater than 2 with "
+                "interleaved schedule"
+            )
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = 0
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = (
+            virtual_pipeline_model_parallel_size_
+        )
+    else:
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
+
+    # Mesh layout (data, pipe, [context,] tensor): TP innermost = adjacent
+    # devices, matching the reference's TP-contiguous rank mapping
+    # (parallel_state.py:117-135) and putting TP traffic on the shortest
+    # ICI paths.
+    dev_array = np.asarray(devices).reshape(dp, pp, cp, tp)
+    axis_names: Tuple[str, ...]
+    if cp > 1:
+        axis_names = (DATA_AXIS, PIPE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+    else:
+        dev_array = dev_array.reshape(dp, pp, tp)
+        axis_names = (DATA_AXIS, PIPE_AXIS, TENSOR_AXIS)
+
+    _MESH = Mesh(dev_array, axis_names)
+    _TENSOR_MODEL_PARALLEL_WORLD_SIZE = tp
+    _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = pp
+    _DATA_PARALLEL_WORLD_SIZE = dp
+    _CONTEXT_PARALLEL_WORLD_SIZE = cp
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def destroy_model_parallel():
+    """Reset all state (reference: parallel_state.py:373-396)."""
+    global _MESH, _TENSOR_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_WORLD_SIZE, _DATA_PARALLEL_WORLD_SIZE
+    global _CONTEXT_PARALLEL_WORLD_SIZE
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    _MESH = None
+    _TENSOR_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _DATA_PARALLEL_WORLD_SIZE = None
+    _CONTEXT_PARALLEL_WORLD_SIZE = 1
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
+
+
+def _require_init():
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel state is not initialized; call "
+            "parallel_state.initialize_model_parallel(...) first"
+        )
+
+
+def get_mesh() -> Mesh:
+    _require_init()
+    return _MESH
+
+
+def get_data_parallel_axis_name() -> str:
+    return DATA_AXIS
+
+
+def get_tensor_model_parallel_axis_name() -> str:
+    return TENSOR_AXIS
+
+
+def get_pipeline_model_parallel_axis_name() -> str:
+    return PIPE_AXIS
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    _require_init()
+    return _TENSOR_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    _require_init()
+    return _PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_data_parallel_world_size() -> int:
+    _require_init()
+    return _DATA_PARALLEL_WORLD_SIZE
+
+
+def get_context_parallel_world_size() -> int:
+    _require_init()
+    return _CONTEXT_PARALLEL_WORLD_SIZE
+
+
+# -- rank helpers -------------------------------------------------------
+#
+# Inside shard_map these return traced values via lax.axis_index; in
+# schedule-construction code pass `rank=` explicitly.
+
+
+def get_tensor_model_parallel_rank(rank: Optional[int] = None):
+    if rank is not None:
+        return rank
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank(rank: Optional[int] = None):
+    if rank is not None:
+        return rank
+    return jax.lax.axis_index(PIPE_AXIS)
+
+
+def get_data_parallel_rank(rank: Optional[int] = None):
+    if rank is not None:
+        return rank
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def is_pipeline_first_stage(rank: Optional[int] = None, ignore_virtual: bool = False):
+    """First-stage predicate (reference: parallel_state.py:277-292).
+
+    With virtual pipelining, only virtual chunk 0 on stage 0 is "first"
+    unless ignore_virtual.
+    """
+    if not ignore_virtual:
+        vp = _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+        if vp is not None and get_virtual_pipeline_model_parallel_rank() != 0:
+            return False
+    r = get_pipeline_model_parallel_rank(rank)
+    return r == 0
+
+
+def is_pipeline_last_stage(rank: Optional[int] = None, ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vp = _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+        if vp is not None and get_virtual_pipeline_model_parallel_rank() != (vp - 1):
+            return False
+    r = get_pipeline_model_parallel_rank(rank)
+    last = get_pipeline_model_parallel_world_size() - 1
+    return r == last
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int):
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def get_num_layers(num_layers: int, is_encoder_and_decoder_model: bool = False) -> int:
+    """Layers per pipeline stage (reference: parallel_state.py:313-345)."""
+    _require_init()
+    pp = get_pipeline_model_parallel_world_size()
+    if pp > 1:
+        if is_encoder_and_decoder_model:
+            split = _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+            if split is None:
+                raise RuntimeError(
+                    "pipeline_model_parallel_split_rank must be set for "
+                    "encoder-decoder models with pipeline parallelism"
+                )
+            num_ranks_in_encoder = split
+            num_ranks_in_decoder = pp - split
+            if num_layers % num_ranks_in_encoder != 0:
+                raise RuntimeError(
+                    f"num_layers ({num_layers}) must be divisible by number of "
+                    f"ranks given to encoder ({num_ranks_in_encoder})"
+                )
+            return num_layers // num_ranks_in_encoder
+        if num_layers % pp != 0:
+            raise RuntimeError(
+                f"num_layers ({num_layers}) must be divisible by pipeline "
+                f"model parallel size ({pp})"
+            )
+        return num_layers // pp
+    return num_layers
+
+
+def get_rank_info() -> str:
+    """(tp, pp, dp) sizes + process index for rank-aware logging
+    (reference: parallel_state.py:169-186)."""
+    if model_parallel_is_initialized():
+        return (
+            f"tp{_TENSOR_MODEL_PARALLEL_WORLD_SIZE}-"
+            f"pp{_PIPELINE_MODEL_PARALLEL_WORLD_SIZE}-"
+            f"dp{_DATA_PARALLEL_WORLD_SIZE}-proc{jax.process_index()}"
+        )
+    return "(-, -, -)"

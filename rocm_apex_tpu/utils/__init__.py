@@ -1,0 +1,15 @@
+from rocm_apex_tpu.utils.tree import (
+    cast_floating,
+    tree_cast,
+    tree_size,
+    is_batchnorm_path,
+    path_str,
+)
+
+__all__ = [
+    "cast_floating",
+    "tree_cast",
+    "tree_size",
+    "is_batchnorm_path",
+    "path_str",
+]

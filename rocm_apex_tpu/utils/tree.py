@@ -1,0 +1,82 @@
+"""Pytree dtype utilities.
+
+The reference casts `nn.Module`s in place (`model.to(dtype)` /
+`convert_network`, reference: apex/amp/_initialize.py:176-182 and
+apex/fp16_utils/fp16util.py:35-88). In JAX parameters are pytrees, so the
+equivalents are pure tree-mapping functions. Non-floating leaves (ints,
+bools, PRNG keys) are never touched, mirroring the reference's
+floating-point-only casts.
+"""
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+# Path-name fragments that identify batch-norm / normalization parameters,
+# used for `keep_batchnorm_fp32` (reference keeps _BatchNorm modules in
+# fp32 via convert_network, apex/fp16_utils/fp16util.py:60-88).
+_BN_PATH_TOKENS = ("batchnorm", "batch_norm", "bn", "batch_stats", "syncbatchnorm")
+
+
+def path_str(path) -> str:
+    """Render a jax.tree_util key path as a '/'-joined lowercase string."""
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts).lower()
+
+
+def is_batchnorm_path(path) -> bool:
+    # Match whole path segments (or a numbered segment like "bn1" /
+    # "batchnorm_0"), not raw substrings — "subnet" must not match "bn".
+    segments = path_str(path).split("/")
+    return any(
+        re.fullmatch(tok + r"_?\d*", seg)
+        for seg in segments
+        for tok in _BN_PATH_TOKENS
+    )
+
+
+def cast_floating(x, dtype):
+    """Cast a single leaf to `dtype` iff it is a floating array."""
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(dtype)
+    return x
+
+
+def tree_cast(
+    tree: Pytree,
+    dtype,
+    keep_fp32_predicate: Optional[Callable[[Any], bool]] = None,
+) -> Pytree:
+    """Cast every floating leaf of `tree` to `dtype`.
+
+    `keep_fp32_predicate(path) -> bool` exempts matching leaves, which stay
+    float32 — the analogue of `convert_network`'s batch-norm exemption
+    (reference: apex/fp16_utils/fp16util.py:60-88).
+    """
+    if keep_fp32_predicate is None:
+        return jax.tree_util.tree_map(lambda x: cast_floating(x, dtype), tree)
+
+    def _cast(path, x):
+        if keep_fp32_predicate(path):
+            return cast_floating(x, jnp.float32)
+        return cast_floating(x, dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def tree_size(tree: Pytree) -> int:
+    """Total number of elements across all leaves."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "size"))

@@ -1,0 +1,243 @@
+"""amp policy/opt-level tests.
+
+Mirrors the reference's L0 run_amp tier (reference: tests/L0/run_amp/):
+per-opt-level cast behavior, property consistency checks, decorator
+casting, and state_dict round-trips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rocm_apex_tpu import amp
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32), "bias": jnp.zeros((4,), jnp.float32)},
+        "bn": {"scale": jnp.ones((4,), jnp.float32), "bias": jnp.zeros((4,), jnp.float32)},
+    }
+
+
+class TestOptLevels:
+    def test_o0_properties(self):
+        p = amp.build_policy("O0")
+        assert p.cast_model_dtype == jnp.float32
+        assert not p.cast_functions
+        assert p.loss_scale == 1.0
+        assert p.master_weights is False
+
+    def test_o1_properties(self):
+        p = amp.build_policy("O1")
+        assert p.cast_model_dtype is None
+        assert p.cast_functions
+        assert p.cast_functions_dtype == jnp.float16
+        assert p.loss_scale == "dynamic"
+
+    def test_o2_properties(self):
+        p = amp.build_policy("O2")
+        assert p.cast_model_dtype == jnp.float16
+        assert p.keep_batchnorm_fp32 is True
+        assert p.master_weights is True
+        assert p.loss_scale == "dynamic"
+
+    def test_o3_properties(self):
+        p = amp.build_policy("O3")
+        assert p.cast_model_dtype == jnp.float16
+        assert p.keep_batchnorm_fp32 is False
+        assert p.loss_scale == 1.0
+
+    def test_o4_properties(self):
+        p = amp.build_policy("O4")
+        assert p.cast_functions
+        assert p.cast_functions_dtype == jnp.bfloat16
+        assert float(p.loss_scale) == 1.0
+
+    def test_o5_properties(self):
+        p = amp.build_policy("O5")
+        assert p.cast_model_dtype == jnp.bfloat16
+        assert p.keep_batchnorm_fp32 is True
+        assert p.master_weights is True
+        assert float(p.loss_scale) == 1.0
+
+    def test_bad_level_raises(self):
+        with pytest.raises(amp.AmpError):
+            amp.build_policy("O7")
+
+    def test_master_weights_invalid_for_o1(self):
+        with pytest.raises(amp.AmpError):
+            amp.build_policy("O1", master_weights=True)
+
+    def test_keep_bn_invalid_for_o4(self):
+        with pytest.raises(amp.AmpError):
+            amp.build_policy("O4", keep_batchnorm_fp32=True)
+
+    def test_loss_scale_override(self):
+        p = amp.build_policy("O2", loss_scale=128.0)
+        assert p.loss_scale == 128.0
+        p = amp.build_policy("O0", loss_scale="dynamic")
+        assert p.loss_scale == "dynamic"
+
+
+class TestInitializeCasting:
+    def test_o2_casts_params_keeps_bn_fp32(self):
+        params, _, state = amp.initialize(_params(), opt_level="O2", verbosity=0)
+        assert params["dense"]["kernel"].dtype == jnp.float16
+        assert params["bn"]["scale"].dtype == jnp.float32
+        assert state.policy.opt_level == "O2"
+
+    def test_o3_casts_everything(self):
+        params, _, _ = amp.initialize(_params(), opt_level="O3", verbosity=0)
+        assert params["dense"]["kernel"].dtype == jnp.float16
+        assert params["bn"]["scale"].dtype == jnp.float16
+
+    def test_o5_bf16_keeps_bn_fp32(self):
+        params, _, _ = amp.initialize(_params(), opt_level="O5", verbosity=0)
+        assert params["dense"]["kernel"].dtype == jnp.bfloat16
+        assert params["bn"]["scale"].dtype == jnp.float32
+
+    def test_o1_leaves_params_fp32(self):
+        params, _, _ = amp.initialize(_params(), opt_level="O1", verbosity=0)
+        assert params["dense"]["kernel"].dtype == jnp.float32
+
+    def test_int_leaves_untouched(self):
+        tree = {"w": jnp.ones((2,), jnp.float32), "step": jnp.asarray(3, jnp.int32)}
+        params, _, _ = amp.initialize(tree, opt_level="O3", verbosity=0)
+        assert params["step"].dtype == jnp.int32
+
+
+class TestDecorators:
+    def test_half_function_under_o1(self):
+        amp.initialize(_params(), opt_level="O1", verbosity=0)
+        seen = {}
+
+        @amp.half_function
+        def f(x):
+            seen["dtype"] = x.dtype
+            return x
+
+        f(jnp.ones((2,), jnp.float32))
+        assert seen["dtype"] == jnp.float16
+        amp.init(None)
+
+    def test_policy_function_under_o4(self):
+        amp.initialize(_params(), opt_level="O4", verbosity=0)
+        seen = {}
+
+        @amp.policy_function
+        def f(x):
+            seen["dtype"] = x.dtype
+            return x
+
+        f(jnp.ones((2,), jnp.float32))
+        assert seen["dtype"] == jnp.bfloat16
+        amp.init(None)
+
+    def test_float_function_casts_up(self):
+        amp.initialize(_params(), opt_level="O1", verbosity=0)
+        seen = {}
+
+        @amp.float_function
+        def f(x):
+            seen["dtype"] = x.dtype
+            return x
+
+        f(jnp.ones((2,), jnp.float16))
+        assert seen["dtype"] == jnp.float32
+        amp.init(None)
+
+    def test_promote_function(self):
+        amp.initialize(_params(), opt_level="O1", verbosity=0)
+        seen = {}
+
+        @amp.promote_function
+        def f(x, y):
+            seen["x"] = x.dtype
+            seen["y"] = y.dtype
+            return x + y
+
+        f(jnp.ones((2,), jnp.float16), jnp.ones((2,), jnp.float32))
+        assert seen["x"] == jnp.float32 and seen["y"] == jnp.float32
+        amp.init(None)
+
+    def test_decorators_inactive_without_policy(self):
+        amp.init(None)
+        seen = {}
+
+        @amp.half_function
+        def f(x):
+            seen["dtype"] = x.dtype
+            return x
+
+        f(jnp.ones((2,), jnp.float32))
+        assert seen["dtype"] == jnp.float32
+
+    def test_disable_casts(self):
+        amp.initialize(_params(), opt_level="O1", verbosity=0)
+        seen = {}
+
+        @amp.half_function
+        def f(x):
+            seen["dtype"] = x.dtype
+            return x
+
+        with amp.disable_casts():
+            f(jnp.ones((2,), jnp.float32))
+        assert seen["dtype"] == jnp.float32
+        amp.init(None)
+
+
+class TestStateDict:
+    def test_round_trip(self):
+        _, _, state = amp.initialize(_params(), opt_level="O2", num_losses=2, verbosity=0)
+        sd = amp.state_dict(state)
+        assert set(sd) == {"loss_scaler0", "loss_scaler1"}
+        assert sd["loss_scaler0"]["loss_scale"] == 2.0**16
+
+        sd["loss_scaler1"]["loss_scale"] = 512.0
+        sd["loss_scaler1"]["unskipped"] = 7
+        state2 = amp.load_state_dict(state, sd)
+        assert float(state2.scaler_states[1].loss_scale) == 512.0
+        assert int(state2.scaler_states[1].unskipped) == 7
+
+    def test_amp_state_is_pytree(self):
+        _, _, state = amp.initialize(_params(), opt_level="O2", verbosity=0)
+        leaves = jax.tree_util.tree_leaves(state)
+        assert len(leaves) == 3  # one ScalerState
+        state2 = jax.tree_util.tree_map(lambda x: x, state)
+        assert state2.policy.opt_level == "O2"
+
+
+class TestMasterWeights:
+    def test_wrapped_optimizer_tracks_fp32_master(self):
+        params = {"w": jnp.asarray([1.0, 2.0, 3.0], jnp.bfloat16)}
+        tx = amp.with_master_weights(optax.sgd(0.25))
+        opt_state = tx.init(params)
+        master = opt_state.master["w"]
+        assert master.dtype == jnp.float32
+
+        grads = {"w": jnp.asarray([1.0, 1.0, 1.0], jnp.bfloat16)}
+        updates, opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        # model params == round(master) after the step
+        np.testing.assert_allclose(
+            np.asarray(new_params["w"], np.float32),
+            np.asarray(opt_state.master["w"].astype(jnp.bfloat16), np.float32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(opt_state.master["w"]), [0.75, 1.75, 2.75], rtol=1e-6
+        )
+
+    def test_master_accumulates_below_bf16_resolution(self):
+        # many tiny updates that individually round to nothing in bf16 must
+        # accumulate in the fp32 master (the whole point of master weights)
+        params = {"w": jnp.asarray([256.0], jnp.bfloat16)}
+        tx = amp.with_master_weights(optax.sgd(1.0))
+        state = tx.init(params)
+        g = {"w": jnp.asarray([0.125], jnp.bfloat16)}
+        for _ in range(16):
+            updates, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+        np.testing.assert_allclose(np.asarray(state.master["w"]), [254.0])

@@ -1,0 +1,163 @@
+"""LossScaler semantics vs the reference constants
+(reference: apex/amp/scaler.py:47-63, 206-226): init 2**16, x2 growth per
+2000 unskipped steps, /2 backoff on overflow, 2**24 max clamp, skip-step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocm_apex_tpu import amp
+from rocm_apex_tpu.amp.scaler import LossScaler
+
+
+class TestDynamicScaler:
+    def test_init_scale(self):
+        s = LossScaler("dynamic")
+        st = s.init()
+        assert float(st.loss_scale) == 2.0**16
+
+    def test_backoff_on_overflow(self):
+        s = LossScaler("dynamic")
+        st = s.init()
+        st, skip = s.update(st, jnp.asarray(True))
+        assert bool(skip)
+        assert float(st.loss_scale) == 2.0**15
+        assert int(st.unskipped) == 0
+
+    def test_growth_after_window(self):
+        s = LossScaler("dynamic", scale_window=4)
+        st = s.init()
+        for i in range(4):
+            st, skip = s.update(st, jnp.asarray(False))
+            assert not bool(skip)
+        assert float(st.loss_scale) == 2.0**17
+        assert int(st.unskipped) == 0
+
+    def test_overflow_resets_window(self):
+        s = LossScaler("dynamic", scale_window=4)
+        st = s.init()
+        st, _ = s.update(st, jnp.asarray(False))
+        st, _ = s.update(st, jnp.asarray(False))
+        st, _ = s.update(st, jnp.asarray(True))  # overflow: window resets
+        for _ in range(3):
+            st, _ = s.update(st, jnp.asarray(False))
+        # 2**15 after backoff; only 3 clean steps < window → no growth
+        assert float(st.loss_scale) == 2.0**15
+
+    def test_max_clamp(self):
+        s = LossScaler("dynamic", init_scale=2.0**24, scale_window=1)
+        st = s.init()
+        st, _ = s.update(st, jnp.asarray(False))
+        assert float(st.loss_scale) == 2.0**24
+
+    def test_min_clamp(self):
+        s = LossScaler("dynamic", init_scale=2.0, min_loss_scale=1.0)
+        st = s.init()
+        st, _ = s.update(st, jnp.asarray(True))
+        st, _ = s.update(st, jnp.asarray(True))
+        assert float(st.loss_scale) == 1.0
+
+    def test_update_is_jittable(self):
+        s = LossScaler("dynamic")
+        st = s.init()
+
+        @jax.jit
+        def step(st, inf):
+            return s.update(st, inf)
+
+        st, skip = step(st, jnp.asarray(True))
+        assert bool(skip)
+        assert float(st.loss_scale) == 2.0**15
+
+
+class TestStaticScaler:
+    def test_never_skips_never_changes(self):
+        s = LossScaler(128.0)
+        st = s.init()
+        assert float(st.loss_scale) == 128.0
+        st, skip = s.update(st, jnp.asarray(True))
+        assert not bool(skip)
+        assert float(st.loss_scale) == 128.0
+
+
+class TestUnscaleProbe:
+    def test_scale_unscale_round_trip(self):
+        s = LossScaler("dynamic")
+        st = s.init()
+        loss = jnp.asarray(2.5, jnp.bfloat16)
+        scaled = s.scale(st, loss)
+        assert scaled.dtype == jnp.float32
+        assert float(scaled) == 2.5 * 2.0**16
+
+        grads = {"a": jnp.full((3,), 2.0**16, jnp.float32)}
+        unscaled, found_inf = s.unscale(st, grads)
+        np.testing.assert_allclose(np.asarray(unscaled["a"]), 1.0)
+        assert not bool(found_inf)
+
+    def test_inf_detection(self):
+        s = LossScaler("dynamic")
+        st = s.init()
+        grads = {"a": jnp.asarray([1.0, jnp.inf]), "b": jnp.ones((2,))}
+        _, found_inf = s.unscale(st, grads)
+        assert bool(found_inf)
+
+    def test_nan_detection(self):
+        s = LossScaler("dynamic")
+        st = s.init()
+        grads = {"a": jnp.asarray([1.0, jnp.nan])}
+        _, found_inf = s.unscale(st, grads)
+        assert bool(found_inf)
+
+    def test_unscale_with_stashed(self):
+        s = LossScaler(2.0)
+        st = s.init()
+        stashed = {"a": jnp.ones((2,), jnp.float32)}
+        grads = {"a": jnp.full((2,), 4.0, jnp.float32)}
+        out, found_inf = s.unscale_with_stashed(st, stashed, grads)
+        np.testing.assert_allclose(np.asarray(out["a"]), 3.0)
+        assert not bool(found_inf)
+
+
+class TestSkipStep:
+    def test_skip_selects_old(self):
+        old = {"w": jnp.zeros((2,)), "n": jnp.asarray(0)}
+        new = {"w": jnp.ones((2,)), "n": jnp.asarray(1)}
+        out = amp.skip_step(jnp.asarray(True), new, old)
+        np.testing.assert_allclose(np.asarray(out["w"]), 0.0)
+        out = amp.skip_step(jnp.asarray(False), new, old)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+    def test_full_amp_train_step_skips_on_overflow(self):
+        """End-to-end jitted step: overflow grads → params unchanged, scale halved."""
+        import optax
+
+        params = {"w": jnp.ones((2,), jnp.float32)}
+        tx = optax.sgd(0.1)
+        opt_state = tx.init(params)
+        _, _, amp_state = amp.initialize(params, opt_level="O2", verbosity=0)
+
+        def loss_fn(p, x):
+            return jnp.sum(p["w"] * x)
+
+        @jax.jit
+        def train_step(params, opt_state, amp_state, x):
+            grads = jax.grad(
+                lambda p: amp.scale_loss(loss_fn(p, x), amp_state)
+            )(params)
+            grads, found_inf = amp.unscale_grads(grads, amp_state)
+            amp_state, should_skip = amp.update_scale(amp_state, found_inf)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            params, opt_state = amp.skip_step(
+                should_skip, (new_params, new_opt_state), (params, opt_state)
+            )
+            return params, opt_state, amp_state
+
+        # clean step
+        p1, o1, a1 = train_step(params, opt_state, amp_state, jnp.ones((2,)))
+        np.testing.assert_allclose(np.asarray(p1["w"]), 0.9, rtol=1e-6)
+        # overflow step: x=inf → params frozen, scale halves
+        p2, o2, a2 = train_step(p1, o1, a1, jnp.asarray([jnp.inf, 1.0]))
+        np.testing.assert_allclose(np.asarray(p2["w"]), 0.9, rtol=1e-6)
+        assert float(a2.scaler_states[0].loss_scale) == 2.0**15

@@ -1,0 +1,42 @@
+"""Test harness: CPU-simulated 8-device mesh.
+
+The reference tests multi-process logic on real 2+ GPU hosts
+(reference: tests/distributed/, apex/transformer/testing/commons.py:70-123).
+The TPU build does better: XLA's host-platform device-count flag simulates
+an N-device mesh on CPU, so every distributed code path (DP/TP/PP/ZeRO)
+runs in single-process unit tests. This must run before jax is imported
+anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test starts with a clean mesh/"mpu" state and no active amp
+    policy, even if the previous test failed mid-way."""
+    yield
+    from rocm_apex_tpu import amp
+    from rocm_apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    amp.init(None)
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 simulated devices")
+    return devs[:8]
