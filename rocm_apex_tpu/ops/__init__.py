@@ -1,0 +1,23 @@
+"""Pallas/Mosaic kernel layer shared by the whole framework.
+
+TPU-native replacement for the reference's native kernel tier
+(reference: csrc/ and apex/contrib/csrc/, SURVEY.md §2.7-2.8). Device
+code is Pallas; there is no CUDA/HIP anywhere. The multi-tensor-apply
+design (reference: csrc/multi_tensor_apply.cuh:16-147) becomes a
+*packed-pytree* design: parameter pytrees are flattened into a handful of
+dtype-segregated, lane-aligned flat buffers, and each "multi-tensor op"
+is ONE pallas_call over the packed buffer instead of a chunked launch
+over up-to-110-tensor argument packs.
+
+Modules:
+    packing       PackedTree: dtype-bucketed (rows, 128*8) buffers
+    multi_tensor  scale / axpby / l2norm (+per-tensor) fused ops
+    optim_kernels adam / sgd / adagrad / novograd / lamb update kernels
+    layer_norm    row-tiled LN fwd/bwd
+    softmax       scaled masked / causal softmax
+    xentropy      label-smoothing softmax cross-entropy
+    flash_attention  fused attention (contrib fmha/mha superseder)
+"""
+
+from rocm_apex_tpu.ops.packing import PackedTree, pack_tree, unpack_tree  # noqa: F401
+from rocm_apex_tpu.ops import multi_tensor  # noqa: F401

@@ -1,0 +1,48 @@
+"""Shared Pallas plumbing: backend detection + interpret-mode fallback.
+
+Every kernel in rocm_apex_tpu/ops is written for TPU (Mosaic) but must
+also run under the CPU test harness (tests/conftest.py simulates an
+8-device mesh on CPU). `pallas_call` here transparently switches to the
+Pallas interpreter off-TPU — the analogue of the reference's pure-python
+fallbacks selected on failed extension import
+(reference: apex/parallel/__init__.py:14-19, apex/amp/scaler.py:6-40).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["pallas_call", "on_tpu", "LANE", "SUBLANE"]
+
+# One packed "row" is a full fp32 VREG tile row: 8 sublanes x 128 lanes.
+SUBLANE = 8
+LANE = 128
+
+
+@functools.cache
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def pallas_call(kernel, **kwargs):
+    """`pl.pallas_call` that interprets off-TPU (CPU test harness)."""
+    if not on_tpu():
+        kwargs.setdefault("interpret", True)
+    return pl.pallas_call(kernel, **kwargs)
+
+
+def kernel_dtype(dtype) -> jnp.dtype:
+    """The dtype a buffer must be presented to Mosaic in.
+
+    TPU Mosaic has no f16 compute type ("Unsupported type in mosaic
+    dialect: f16") — fp16 buffers are up-cast to f32 at the kernel
+    boundary and cast back outside. fp16 is a capability-parity path
+    (amp O1-O3); the TPU-primary dtype is bf16, which Mosaic handles
+    natively.
+    """
+    dt = jnp.dtype(dtype)
+    if on_tpu() and dt == jnp.float16:
+        return jnp.dtype(jnp.float32)
+    return dt

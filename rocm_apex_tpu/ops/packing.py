@@ -1,0 +1,225 @@
+"""Packed-pytree buffers: the TPU-native multi-tensor-apply substrate.
+
+The reference chunks up-to-110-tensor address packs into repeated kernel
+launches (reference: csrc/multi_tensor_apply.cuh:16-26 `TensorListMetadata`,
+:44-147 chunking loop). On TPU the idiomatic equivalent is to flatten the
+whole parameter pytree ONCE into a few dtype-segregated, lane-aligned 2-D
+buffers and run every "multi-tensor" op as a single Pallas call over the
+packed buffer — no chunk bookkeeping, no launch loop, and XLA sees one
+fused program.
+
+Layout invariants:
+  * one buffer per parameter dtype (the analogue of the reference DDP's
+    dtype-segregated grad buckets, apex/parallel/distributed.py:241-244);
+  * each leaf starts on a fresh row of ``WIDTH = 8*128`` elements, so a
+    row never straddles two tensors — which makes per-tensor quantities
+    (LAMB trust ratios, per-tensor L2 norms,
+    csrc/multi_tensor_l2norm_kernel.cu:29-114) expressible as segmented
+    row reductions;
+  * buffer row counts are padded to ``ALIGN_ROWS`` with zeros so every
+    Pallas grid block is full (zero padding is harmless for every op in
+    this layer: scales/axpby map 0→0, norms add 0, optimizer updates of
+    zero-initialized zero-grad rows stay 0).
+
+`PackSpec` is hashable static metadata (safe as a jit-static argument);
+`PackedTree` is a registered pytree whose children are the buffers.
+"""
+
+import functools
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocm_apex_tpu.ops._pallas import LANE, SUBLANE
+
+__all__ = [
+    "WIDTH",
+    "ALIGN_ROWS",
+    "LeafSpec",
+    "GroupSpec",
+    "PackSpec",
+    "PackedTree",
+    "build_pack_spec",
+    "pack_tree",
+    "pack_like",
+    "unpack_tree",
+    "group_segment_ids",
+]
+
+WIDTH = SUBLANE * LANE  # 1024: one fp32 VREG worth of elements per row
+ALIGN_ROWS = 64  # block-grid alignment (multiple of every dtype's sublane tile)
+
+
+class LeafSpec(NamedTuple):
+    """Static placement of one pytree leaf inside its dtype-group buffer."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    row_start: int
+    nrows: int
+    numel: int
+
+
+class GroupSpec(NamedTuple):
+    """One dtype-segregated buffer: which leaves it holds and where."""
+
+    dtype: str
+    leaf_indices: Tuple[int, ...]  # indices into the flattened-tree leaf list
+    leaf_specs: Tuple[LeafSpec, ...]
+    rows: int  # padded to ALIGN_ROWS
+
+
+class PackSpec(NamedTuple):
+    treedef: Any
+    groups: Tuple[GroupSpec, ...]
+    n_leaves: int
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def build_pack_spec(tree: Any) -> PackSpec:
+    """Compute the static packing layout for a pytree of floating arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    leaves = [jnp.asarray(x) for x in leaves]
+    by_dtype = {}
+    for i, leaf in enumerate(leaves):
+        dt = leaf.dtype
+        if not jnp.issubdtype(dt, jnp.inexact):
+            raise TypeError(
+                f"pack_tree only packs floating leaves; leaf {i} has dtype {dt}"
+            )
+        by_dtype.setdefault(jnp.dtype(dt).name, []).append(i)
+
+    groups = []
+    for dtype_name in sorted(by_dtype):
+        idxs = by_dtype[dtype_name]
+        specs = []
+        row = 0
+        for i in idxs:
+            leaf = leaves[i]
+            numel = int(np.prod(leaf.shape)) if leaf.shape else 1
+            nrows = max(1, -(-numel // WIDTH))
+            specs.append(
+                LeafSpec(
+                    shape=tuple(leaf.shape),
+                    dtype=dtype_name,
+                    row_start=row,
+                    nrows=nrows,
+                    numel=numel,
+                )
+            )
+            row += nrows
+        groups.append(
+            GroupSpec(
+                dtype=dtype_name,
+                leaf_indices=tuple(idxs),
+                leaf_specs=tuple(specs),
+                rows=_round_up(max(row, 1), ALIGN_ROWS),
+            )
+        )
+    return PackSpec(treedef=treedef, groups=tuple(groups), n_leaves=len(leaves))
+
+
+@jax.tree_util.register_pytree_node_class
+class PackedTree:
+    """A pytree packed into dtype-segregated (rows, WIDTH) buffers."""
+
+    def __init__(self, buffers: Sequence[jnp.ndarray], spec: PackSpec):
+        self.buffers = tuple(buffers)
+        self.spec = spec
+
+    def tree_flatten(self):
+        return self.buffers, self.spec
+
+    @classmethod
+    def tree_unflatten(cls, spec, buffers):
+        return cls(buffers, spec)
+
+    def __repr__(self):
+        shapes = ", ".join(
+            f"{g.dtype}[{g.rows}x{WIDTH}]" for g in self.spec.groups
+        )
+        return f"PackedTree({shapes}, n_leaves={self.spec.n_leaves})"
+
+
+def _pack_group(leaves, group: GroupSpec, cast: bool) -> jnp.ndarray:
+    parts = []
+    for i, ls in zip(group.leaf_indices, group.leaf_specs):
+        flat = jnp.ravel(jnp.asarray(leaves[i]))
+        if cast:
+            flat = flat.astype(group.dtype)
+        elif flat.dtype != jnp.dtype(group.dtype):
+            raise TypeError(
+                f"leaf {i} has dtype {flat.dtype} but the pack spec expects "
+                f"{group.dtype}; use pack_like() to pack a tree whose dtypes "
+                "differ from the spec's"
+            )
+        pad = ls.nrows * WIDTH - ls.numel
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        parts.append(flat)
+    used_rows = sum(ls.nrows for ls in group.leaf_specs)
+    tail = group.rows - used_rows
+    if tail or not parts:
+        parts.append(jnp.zeros((tail * WIDTH,), dtype=group.dtype))
+    return jnp.concatenate(parts).reshape(group.rows, WIDTH)
+
+
+def pack_tree(tree: Any, spec: Optional[PackSpec] = None) -> PackedTree:
+    """Pack a pytree into lane-aligned buffers (layout from `spec` if given)."""
+    if spec is None:
+        spec = build_pack_spec(tree)
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) != spec.n_leaves:
+        raise ValueError(
+            f"tree has {len(leaves)} leaves but spec describes {spec.n_leaves}"
+        )
+    buffers = [_pack_group(leaves, g, cast=False) for g in spec.groups]
+    return PackedTree(buffers, spec)
+
+
+def pack_like(spec: PackSpec, tree: Any) -> PackedTree:
+    """Pack `tree` (same structure/shapes) into `spec`'s layout, casting each
+    leaf to its group dtype.
+
+    Used to align gradient pytrees with a parameter packing even when
+    their dtypes differ (e.g. fp32 unscaled grads against bf16 params —
+    the master-weight flow, reference: apex/amp/_process_optimizer.py:161-207).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) != spec.n_leaves:
+        raise ValueError(
+            f"tree has {len(leaves)} leaves but spec describes {spec.n_leaves}"
+        )
+    buffers = [_pack_group(leaves, g, cast=True) for g in spec.groups]
+    return PackedTree(buffers, spec)
+
+
+def unpack_tree(packed: PackedTree) -> Any:
+    """Invert `pack_tree`: slice each leaf back out of its group buffer."""
+    spec = packed.spec
+    leaves = [None] * spec.n_leaves
+    for buf, group in zip(packed.buffers, spec.groups):
+        flat = buf.reshape(-1)
+        for i, ls in zip(group.leaf_indices, group.leaf_specs):
+            start = ls.row_start * WIDTH
+            leaf = jax.lax.dynamic_slice_in_dim(flat, start, ls.numel)
+            leaves[i] = leaf.reshape(ls.shape)
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+@functools.lru_cache(maxsize=64)
+def group_segment_ids(group: GroupSpec) -> np.ndarray:
+    """row → local-tensor-index map for segmented per-tensor reductions.
+
+    Padding tail rows map to segment `len(leaf_specs)` so they can be
+    dropped from per-tensor results (their contribution is zero anyway).
+    """
+    ids = np.full((group.rows,), len(group.leaf_specs), dtype=np.int32)
+    for j, ls in enumerate(group.leaf_specs):
+        ids[ls.row_start : ls.row_start + ls.nrows] = j
+    return ids

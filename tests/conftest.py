@@ -10,7 +10,11 @@ anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU-simulated mesh even when the environment selects a real
+# accelerator (e.g. JAX_PLATFORMS=axon): distributed tests need 8 devices.
+# Escape hatch for running kernel tests on real hardware:
+#   APEX_TPU_TEST_PLATFORM=axon python -m pytest tests/L0/test_multi_tensor.py
+os.environ["JAX_PLATFORMS"] = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
