@@ -1,0 +1,82 @@
+"""Verify driver: fused optimizers end-to-end training on real TPU."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu import amp, optimizers as opt
+
+print("backend:", jax.default_backend(), jax.devices())
+
+key = jax.random.PRNGKey(0)
+k1, k2, k3 = jax.random.split(key, 3)
+params = {
+    "w1": jax.random.normal(k1, (256, 512), jnp.float32) * 0.05,
+    "b1": jnp.zeros((512,), jnp.float32),
+    "w2": jax.random.normal(k2, (512, 10), jnp.float32) * 0.05,
+}
+x = jax.random.normal(k3, (128, 256), jnp.bfloat16)
+y = jax.random.randint(jax.random.PRNGKey(5), (128,), 0, 10)
+
+
+def loss_fn(p, x, y):
+    h = jnp.maximum(x @ p["w1"].astype(jnp.bfloat16) + p["b1"].astype(jnp.bfloat16), 0)
+    logits = (h @ p["w2"].astype(jnp.bfloat16)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+for name, o in [
+    ("FusedAdam", opt.FusedAdam(lr=5e-3, weight_decay=0.01)),
+    ("FusedLAMB", opt.FusedLAMB(lr=5e-3)),
+    ("FusedSGD", opt.FusedSGD(lr=0.1, momentum=0.9)),
+    ("FusedNovoGrad", opt.FusedNovoGrad(lr=5e-3)),
+    ("FusedAdagrad", opt.FusedAdagrad(lr=5e-2)),
+]:
+    # O5-style: bf16 model + fp32 masters via amp wrapper for Adam only;
+    # others train fp32 directly.
+    p = params
+    state = o.init(p)
+
+    @jax.jit
+    def step(p, s, x, y):
+        g = jax.grad(loss_fn)(p, x, y)
+        return o.step(p, g, s)
+
+    t0 = time.time()
+    p, state = step(p, state, x, y)
+    jax.block_until_ready(p)
+    compile_t = time.time() - t0
+    l0 = float(loss_fn(p, x, y))
+    t0 = time.time()
+    for _ in range(20):
+        p, state = step(p, state, x, y)
+    jax.block_until_ready(p)
+    dt = (time.time() - t0) / 20
+    l1 = float(loss_fn(p, x, y))
+    assert l1 < l0, (name, l0, l1)
+    print(f"{name}: loss {l0:.4f} -> {l1:.4f}, step {dt*1e3:.2f} ms (compile {compile_t:.1f}s)")
+
+# mixed-precision LAMB with scaler integration on bf16 params
+p16, _, amp_state = amp.initialize(params, opt_level="O5", verbosity=0)
+fl = opt.FusedMixedPrecisionLamb(lr=5e-3)
+state = fl.init(p16)
+
+
+@jax.jit
+def mstep(p, s, x, y, scale):
+    g = jax.grad(lambda pp: loss_fn(pp, x, y) * scale)(p)
+    from rocm_apex_tpu.amp.scaler import all_finite
+
+    fi = jnp.logical_not(all_finite(g))
+    return fl.step(p, g, s, inv_scale=1.0 / scale, found_inf=fi)
+
+
+l0 = float(loss_fn(p16, x, y))
+for _ in range(10):
+    p16, state = mstep(p16, state, x, y, jnp.asarray(2.0**10))
+l1 = float(loss_fn(p16, x, y))
+assert l1 < l0, (l0, l1)
+print(f"FusedMixedPrecisionLamb (bf16+scaler): loss {l0:.4f} -> {l1:.4f}")
+print("VERIFY PASS")

@@ -1,0 +1,17 @@
+"""Fused normalization layers (reference: apex/normalization/__init__.py)."""
+
+from rocm_apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    MixedFusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    mixed_dtype_fused_layer_norm_affine,
+)
+
+__all__ = [
+    "FusedLayerNorm",
+    "MixedFusedLayerNorm",
+    "fused_layer_norm",
+    "fused_layer_norm_affine",
+    "mixed_dtype_fused_layer_norm_affine",
+]

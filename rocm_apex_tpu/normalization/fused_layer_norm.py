@@ -1,0 +1,132 @@
+"""Fused LayerNorm: functional API + flax modules.
+
+TPU-native rebuild of `apex.normalization`
+(reference: apex/normalization/fused_layer_norm.py): the autograd
+functions map to `jax.custom_vjp` Pallas kernels (ops/layer_norm.py),
+the `nn.Module`s map to flax linen modules. Dtype contracts preserved:
+
+* `FusedLayerNorm` — output dtype = INPUT dtype
+  (reference: fused_layer_norm.py:102-196);
+* `MixedFusedLayerNorm` — output dtype = PARAM dtype
+  (reference: fused_layer_norm.py:199-218 and the
+  `forward_affine_mixed_dtypes` native path, csrc/layer_norm_cuda.cpp).
+
+Both compute statistics in fp32 regardless of storage dtype, like the
+reference kernels.
+"""
+
+from typing import Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from rocm_apex_tpu.ops import layer_norm as _ln_ops
+
+__all__ = [
+    "fused_layer_norm",
+    "fused_layer_norm_affine",
+    "mixed_dtype_fused_layer_norm_affine",
+    "FusedLayerNorm",
+    "MixedFusedLayerNorm",
+]
+
+Shape = Union[int, Sequence[int]]
+
+
+def _normalize_shape(normalized_shape: Shape) -> Tuple[int, ...]:
+    if isinstance(normalized_shape, (int, np.integer)):
+        return (int(normalized_shape),)
+    return tuple(int(d) for d in normalized_shape)
+
+
+def _to_2d(x, normalized_shape):
+    shape = _normalize_shape(normalized_shape)
+    n = len(shape)
+    if tuple(x.shape[-n:]) != shape:
+        raise ValueError(
+            f"input trailing dims {x.shape[-n:]} != normalized_shape {shape}"
+        )
+    hidden = int(np.prod(shape))
+    return x.reshape(-1, hidden), x.shape
+
+
+def fused_layer_norm(x, normalized_shape: Shape, eps: float = 1e-5):
+    """Non-affine fused LN (reference: fused_layer_norm.py:63-99,187-196)."""
+    x2d, orig_shape = _to_2d(x, normalized_shape)
+    return _ln_ops.layer_norm(x2d, eps).reshape(orig_shape)
+
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape: Shape, eps: float = 1e-5):
+    """Affine fused LN; output dtype = input dtype
+    (reference: fused_layer_norm.py:15-42,84-90)."""
+    shape = _normalize_shape(normalized_shape)
+    hidden = int(np.prod(shape))
+    x2d, orig_shape = _to_2d(x, normalized_shape)
+    y = _ln_ops.layer_norm_affine(
+        x2d, weight.reshape(hidden), bias.reshape(hidden), eps
+    )
+    return y.reshape(orig_shape).astype(x.dtype)
+
+
+def mixed_dtype_fused_layer_norm_affine(
+    x, weight, bias, normalized_shape: Shape, eps: float = 1e-6
+):
+    """Affine fused LN; output dtype = WEIGHT dtype
+    (reference: fused_layer_norm.py:45-61,96-99)."""
+    shape = _normalize_shape(normalized_shape)
+    hidden = int(np.prod(shape))
+    x2d, orig_shape = _to_2d(x, normalized_shape)
+    y = _ln_ops.layer_norm_affine(
+        x2d.astype(weight.dtype), weight.reshape(hidden), bias.reshape(hidden), eps
+    )
+    return y.reshape(orig_shape).astype(weight.dtype)
+
+
+class FusedLayerNorm(nn.Module):
+    """flax module mirroring the reference `FusedLayerNorm`
+    (reference: apex/normalization/fused_layer_norm.py:102-196).
+
+    Attributes follow the reference constructor: `normalized_shape`,
+    `eps`, `elementwise_affine`. `param_dtype` controls parameter
+    storage (fp32 default, like torch).
+    """
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _normalize_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param(
+                "weight", nn.initializers.ones_init(), shape, self.param_dtype
+            )
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), shape, self.param_dtype
+            )
+            return fused_layer_norm_affine(x, weight, bias, shape, self.eps)
+        return fused_layer_norm(x, shape, self.eps)
+
+
+class MixedFusedLayerNorm(nn.Module):
+    """flax module mirroring `MixedFusedLayerNorm`: always affine, output
+    dtype follows the (fp32) params even for bf16/fp16 inputs
+    (reference: apex/normalization/fused_layer_norm.py:199-218)."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _normalize_shape(self.normalized_shape)
+        weight = self.param(
+            "weight", nn.initializers.ones_init(), shape, self.param_dtype
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), shape, self.param_dtype
+        )
+        return mixed_dtype_fused_layer_norm_affine(x, weight, bias, shape, self.eps)

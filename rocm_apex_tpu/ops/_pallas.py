@@ -14,7 +14,15 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["pallas_call", "on_tpu", "LANE", "SUBLANE"]
+__all__ = [
+    "pallas_call",
+    "on_tpu",
+    "LANE",
+    "SUBLANE",
+    "row_block",
+    "pad_rows",
+    "kernel_dtype",
+]
 
 # One packed "row" is a full fp32 VREG tile row: 8 sublanes x 128 lanes.
 SUBLANE = 8
@@ -31,6 +39,27 @@ def pallas_call(kernel, **kwargs):
     if not on_tpu():
         kwargs.setdefault("interpret", True)
     return pl.pallas_call(kernel, **kwargs)
+
+
+def row_block(width: int, itemsize: int = 4, cap: int = 256) -> int:
+    """Row-block size keeping one (block, width) operand ≤ ~2 MiB of VMEM.
+
+    Shared by every row-tiled kernel (layer_norm / softmax / xentropy);
+    rows stay a multiple of 8 (fp32 sublane tile).
+    """
+    target = (2 * 1024 * 1024) // max(1, width * itemsize)
+    return max(8, min(cap, (target // 8) * 8))
+
+
+def pad_rows(x, block: int, axis: int = 0):
+    """Zero-pad `axis` up to a multiple of `block` (grid alignment)."""
+    n = x.shape[axis]
+    padded = (n + block - 1) // block * block
+    if padded != n:
+        pads = [(0, 0)] * x.ndim
+        pads[axis] = (0, padded - n)
+        x = jnp.pad(x, pads)
+    return x
 
 
 def kernel_dtype(dtype) -> jnp.dtype:

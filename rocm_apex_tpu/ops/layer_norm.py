@@ -1,0 +1,244 @@
+"""Row-tiled fused LayerNorm forward/backward in Pallas.
+
+TPU-native equivalent of the fused LayerNorm kernels
+(reference: csrc/layer_norm_cuda.cpp:121-267 +
+csrc/layer_norm_cuda_kernel.cu:875, and the fast_layer_norm contrib
+variant apex/contrib/csrc/layer_norm/). The forward returns
+``(y, mean, rsigma)`` with the row statistics saved for the backward —
+the same contract as the reference's `FusedLayerNormAffineFunction`
+(reference: apex/normalization/fused_layer_norm.py:15-82) — wired up as
+a `jax.custom_vjp` so `jax.grad` uses the fused backward.
+
+Gamma/beta gradients use the reference's two-stage scheme (per-block
+partials in-kernel, final reduction outside —
+layer_norm_cuda_kernel.cu's gamma/beta two-stage reduction).
+
+All math is fp32 in-register; output dtype follows the input (or the
+weight dtype for the mixed variant, handled by the module layer).
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rocm_apex_tpu.ops._pallas import kernel_dtype, pad_rows, pallas_call, row_block
+
+__all__ = ["layer_norm_fwd", "layer_norm", "layer_norm_affine"]
+
+
+def _block_rows(hidden: int) -> int:
+    return row_block(hidden)
+
+
+def _pad_rows(x, block: int):
+    rows = x.shape[0]
+    return pad_rows(x, block), rows
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _ln_fwd_kernel(affine, eps, x_ref, *refs):
+    if affine:
+        g_ref, b_ref, y_ref, mu_ref, rs_ref = refs
+    else:
+        y_ref, mu_ref, rs_ref = refs
+    x = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rs = jax.lax.rsqrt(var + eps)
+    y = xc * rs
+    if affine:
+        y = y * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    mu_ref[...] = mu
+    rs_ref[...] = rs
+
+
+def layer_norm_fwd(
+    x2d: jnp.ndarray,
+    weight: Optional[jnp.ndarray],
+    bias: Optional[jnp.ndarray],
+    eps: float,
+    out_dtype=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """LN forward on a (rows, hidden) view; returns (y, mean, rsigma).
+
+    The (rows, hidden) restriction mirrors the fast LN contract
+    (reference: apex/contrib/layer_norm/layer_norm.py:8-40); the module
+    layer reshapes arbitrary normalized_shape to this view
+    (reference: apex/normalization/fused_layer_norm.py).
+    """
+    rows0, hidden = x2d.shape
+    out_dtype = out_dtype or x2d.dtype
+    affine = weight is not None
+    block = _block_rows(hidden)
+    x2d, rows0 = _pad_rows(x2d, block)
+    rows = x2d.shape[0]
+    grid = rows // block
+
+    x_in = x2d.astype(kernel_dtype(x2d.dtype))
+    ins = [x_in]
+    in_specs = [pl.BlockSpec((block, hidden), lambda i: (i, 0))]
+    if affine:
+        gb_spec = pl.BlockSpec((1, hidden), lambda i: (0, 0))
+        ins += [
+            weight.reshape(1, hidden).astype(kernel_dtype(weight.dtype)),
+            bias.reshape(1, hidden).astype(kernel_dtype(bias.dtype)),
+        ]
+        in_specs += [gb_spec, gb_spec]
+
+    y, mu, rs = pallas_call(
+        functools.partial(_ln_fwd_kernel, affine, eps),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((block, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(out_dtype)),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+    )(*ins)
+    return (
+        y[:rows0].astype(out_dtype),
+        mu[:rows0, 0],
+        rs[:rows0, 0],
+    )
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _ln_bwd_kernel(affine, x_ref, dy_ref, mu_ref, rs_ref, *refs):
+    if affine:
+        g_ref, dx_ref, dg_ref, db_ref = refs
+    else:
+        (dx_ref,) = refs
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    mu = mu_ref[...]
+    rs = rs_ref[...]
+    xhat = (x - mu) * rs
+    if affine:
+        g = g_ref[...].astype(jnp.float32)
+        dyg = dy * g
+        # per-block partials for the two-stage gamma/beta reduction
+        dg_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+        db_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+    else:
+        dyg = dy
+    h = x.shape[1]
+    c1 = jnp.mean(dyg, axis=1, keepdims=True)
+    c2 = jnp.mean(dyg * xhat, axis=1, keepdims=True)
+    dx_ref[...] = (rs * (dyg - c1 - xhat * c2)).astype(dx_ref.dtype)
+
+
+def _layer_norm_bwd(affine, eps, res, dy):
+    x2d, weight, mu, rs = res
+    rows0, hidden = x2d.shape
+    block = _block_rows(hidden)
+    x_p, _ = _pad_rows(x2d, block)
+    dy_p, _ = _pad_rows(dy, block)
+    rows = x_p.shape[0]
+    grid = rows // block
+    mu_p = jnp.pad(mu.reshape(-1, 1), ((0, rows - rows0), (0, 0)))
+    rs_p = jnp.pad(rs.reshape(-1, 1), ((0, rows - rows0), (0, 0)))
+
+    ins = [
+        x_p.astype(kernel_dtype(x_p.dtype)),
+        dy_p.astype(kernel_dtype(dy_p.dtype)),
+        mu_p,
+        rs_p,
+    ]
+    in_specs = [
+        pl.BlockSpec((block, hidden), lambda i: (i, 0)),
+        pl.BlockSpec((block, hidden), lambda i: (i, 0)),
+        pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        pl.BlockSpec((block, 1), lambda i: (i, 0)),
+    ]
+    out_specs = [pl.BlockSpec((block, hidden), lambda i: (i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(x2d.dtype))]
+    if affine:
+        ins.append(weight.reshape(1, hidden).astype(kernel_dtype(weight.dtype)))
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0)))
+        out_specs += [
+            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
+        ]
+
+    outs = pallas_call(
+        functools.partial(_ln_bwd_kernel, affine),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+    )(*ins)
+    if affine:
+        dx, dg_part, db_part = outs
+        dg = dg_part.sum(axis=0).astype(weight.dtype)
+        db = db_part.sum(axis=0).astype(weight.dtype)
+        return dx[:rows0].astype(x2d.dtype), dg, db
+    dx = outs if not isinstance(outs, (tuple, list)) else outs[0]
+    return (dx[:rows0].astype(x2d.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrappers
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm_affine(x2d, weight, bias, eps):
+    """Affine LN on (rows, hidden) with the fused backward."""
+    y, _, _ = layer_norm_fwd(x2d, weight, bias, eps)
+    return y
+
+
+def _lna_fwd(x2d, weight, bias, eps):
+    y, mu, rs = layer_norm_fwd(x2d, weight, bias, eps)
+    return y, (x2d, weight, mu, rs)
+
+
+def _lna_bwd(eps, res, dy):
+    dx, dg, db = _layer_norm_bwd(True, eps, res, dy)
+    return dx, dg, db
+
+
+layer_norm_affine.defvjp(_lna_fwd, _lna_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def layer_norm(x2d, eps):
+    """Non-affine LN on (rows, hidden) with the fused backward."""
+    y, _, _ = layer_norm_fwd(x2d, None, None, eps)
+    return y
+
+
+def _ln_fwd_rule(x2d, eps):
+    y, mu, rs = layer_norm_fwd(x2d, None, None, eps)
+    return y, (x2d, None, mu, rs)
+
+
+def _ln_bwd_rule(eps, res, dy):
+    (dx,) = _layer_norm_bwd(False, eps, res, dy)
+    return (dx,)
+
+
+layer_norm.defvjp(_ln_fwd_rule, _ln_bwd_rule)

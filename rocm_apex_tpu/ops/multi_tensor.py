@@ -27,6 +27,7 @@ from rocm_apex_tpu.ops.packing import (
     PackedTree,
     group_segment_ids,
     pack_tree,
+    respec,
     unpack_tree,
 )
 
@@ -37,6 +38,7 @@ __all__ = [
     "axpby",
     "l2norm_packed",
     "l2norm",
+    "row_sumsq",
 ]
 
 BLOCK_ROWS = 64  # 64x1024 fp32 = 256 KiB per buffer block in VMEM
@@ -59,20 +61,6 @@ def _flag_out_spec():
     return pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM)
 
 
-def _respec(spec, out_dtype):
-    """Rewrite a PackSpec's dtype metadata after an op cast buffers."""
-    if out_dtype is None:
-        return spec
-    name = jnp.dtype(out_dtype).name
-    return spec._replace(
-        groups=tuple(
-            g._replace(
-                dtype=name,
-                leaf_specs=tuple(ls._replace(dtype=name) for ls in g.leaf_specs),
-            )
-            for g in spec.groups
-        )
-    )
 
 
 # ---------------------------------------------------------------------------
@@ -123,7 +111,7 @@ def scale_packed(
         outs.append(out)
         infs.append(inf)
     found_inf = jnp.stack(infs).any() if infs else jnp.asarray(False)
-    return PackedTree(outs, _respec(packed.spec, out_dtype)), found_inf
+    return PackedTree(outs, respec(packed.spec, out_dtype)), found_inf
 
 
 def scale(tree: Any, scale_val, out_dtype=None) -> Tuple[Any, jnp.ndarray]:
@@ -187,7 +175,7 @@ def axpby_packed(
         outs.append(out.astype(od))
         infs.append(flags.sum() > 0)
     found_inf = jnp.stack(infs).any() if infs else jnp.asarray(False)
-    return PackedTree(outs, _respec(x.spec, out_dtype)), found_inf
+    return PackedTree(outs, respec(x.spec, out_dtype)), found_inf
 
 
 def axpby(x: Any, y: Any, a, b) -> Tuple[Any, jnp.ndarray]:
@@ -208,7 +196,7 @@ def _rowsum_sq_kernel(x_ref, out_ref):
     out_ref[...] = jnp.sum(x * x, axis=1, keepdims=True)
 
 
-def _row_sumsq(buf) -> jnp.ndarray:
+def row_sumsq(buf) -> jnp.ndarray:
     rows = buf.shape[0]
     grid = _grid(rows)
     buf = buf.astype(kernel_dtype(buf.dtype))
@@ -239,7 +227,7 @@ def l2norm_packed(
     total = jnp.asarray(0.0, jnp.float32)
     per_group = []
     for buf, group in zip(packed.buffers, packed.spec.groups):
-        row_sq = _row_sumsq(buf)[:, 0]
+        row_sq = row_sumsq(buf)[:, 0]
         total = total + row_sq.sum()
         if per_tensor:
             seg = jnp.asarray(group_segment_ids(group))

@@ -1,0 +1,269 @@
+"""Pallas optimizer-update kernels over packed buffers.
+
+TPU-native equivalents of the fused optimizer kernels
+(reference: csrc/multi_tensor_adam.cu:24-171, multi_tensor_sgd_kernel.cu,
+multi_tensor_adagrad.cu, multi_tensor_novograd.cu, multi_tensor_lamb.cu).
+Each kernel consumes the dtype-group buffers produced by ops/packing and
+emits the fp32 parameter *delta* (so the surrounding optimizer layer can
+expose optax-style updates) plus the new moment buffers. All math is
+fp32 in-register regardless of storage dtype, matching the reference's
+``MATH_T = float`` accumulators.
+
+Per-tensor hyperparameters (weight decay masks, LAMB trust ratios,
+NovoGrad per-tensor second moments) arrive as (rows, 1) fp32 columns —
+legal because the packed layout never lets a row straddle two tensors
+(ops/packing.py). This replaces the reference's per-chunk tensor-id
+lookup (csrc/multi_tensor_apply.cuh:84-146).
+
+Scalar hyperparameters arrive as one (1, K) SMEM vector per call:
+    adam/adagrad/sgd/novograd/lamb share the layout documented next to
+    each kernel. `grad_scale` is a fused gradient unscale multiplier
+    (1/loss_scale), the analogue of the scale-aware kernel variants
+    (reference: apex/contrib/csrc/optimizers/fused_adam_cuda_kernel.cu).
+"""
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rocm_apex_tpu.ops._pallas import kernel_dtype, pallas_call
+from rocm_apex_tpu.ops.packing import WIDTH
+
+__all__ = [
+    "adam_update",
+    "sgd_update",
+    "adagrad_update",
+    "novograd_update",
+    "lamb_stage1",
+    "lamb_stage2",
+]
+
+BLOCK_ROWS = 64
+
+
+def _buf_spec():
+    return pl.BlockSpec((BLOCK_ROWS, WIDTH), lambda i: (i, 0))
+
+
+def _col_spec():
+    return pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0))
+
+
+def _smem_vec_spec(k):
+    return pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _call(kernel, bufs: Sequence, cols: Sequence, scalars, out_dtypes: Sequence):
+    """Run `kernel` over aligned (rows, WIDTH) buffers + (rows, 1) columns.
+
+    kernel signature: (buf_refs..., col_refs..., s_ref, out_refs...).
+    Returns one (rows, WIDTH) output per entry in out_dtypes.
+    """
+    rows = bufs[0].shape[0]
+    assert rows % BLOCK_ROWS == 0, rows
+    grid = rows // BLOCK_ROWS
+    bufs = [b.astype(kernel_dtype(b.dtype)) for b in bufs]
+    s = jnp.asarray(scalars, jnp.float32).reshape(1, -1)
+    kd_outs = [kernel_dtype(d) for d in out_dtypes]
+    outs = pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[_buf_spec() for _ in bufs]
+        + [_col_spec() for _ in cols]
+        + [_smem_vec_spec(s.shape[1])],
+        out_specs=[_buf_spec() for _ in kd_outs],
+        out_shape=[jax.ShapeDtypeStruct((rows, WIDTH), d) for d in kd_outs],
+    )(*bufs, *cols, s)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return [o.astype(d) for o, d in zip(outs, out_dtypes)]
+
+
+# ---------------------------------------------------------------------------
+# Adam / AdamW     scalars: [lr, beta1, beta2, eps, bc1, bc2, grad_scale]
+# ---------------------------------------------------------------------------
+
+
+def _adam_kernel(adam_w_mode, p_ref, g_ref, m_ref, v_ref, wd_ref, s_ref, d_ref, m_out, v_out):
+    lr, b1, b2, eps, bc1, bc2, gs = (s_ref[0, i] for i in range(7))
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32) * gs
+    wd = wd_ref[...]  # (B, 1), broadcasts over lanes
+    if not adam_w_mode:  # L2 mode folds decay into the gradient
+        g = g + wd * p
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode:  # decoupled decay (AdamW)
+        update = update + wd * p
+    d_ref[...] = -lr * update
+    m_out[...] = m
+    v_out[...] = v
+
+
+def adam_update(p, g, m, v, wd_col, scalars, adam_w_mode: bool) -> Tuple:
+    """One fused Adam/AdamW step over a group buffer.
+
+    Mirrors `AdamFunctor` (reference: csrc/multi_tensor_adam.cu:24-171):
+    MODE_0 = L2 (decay into grad), MODE_1 = AdamW (decoupled), fp32 math,
+    bias corrections bc1/bc2 precomputed by the caller (1 - beta^t, or 1
+    with bias_correction off — reference fused_adam.py:117-147).
+    Returns (delta_p_f32, new_m, new_v).
+    """
+    kern = functools.partial(_adam_kernel, adam_w_mode)
+    return _call(
+        kern, [p, g, m, v], [wd_col], scalars, [jnp.float32, m.dtype, v.dtype]
+    )
+
+
+# ---------------------------------------------------------------------------
+# SGD              scalars: [lr, momentum, dampening, first_run, grad_scale]
+# ---------------------------------------------------------------------------
+
+
+def _sgd_kernel(nesterov, wd_after_momentum, momentum_on, p_ref, g_ref, b_ref, wd_ref, s_ref, d_ref, b_out):
+    lr, mom, damp, first, gs = (s_ref[0, i] for i in range(5))
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32) * gs
+    wd = wd_ref[...]
+    if not wd_after_momentum:
+        g = g + wd * p
+    if momentum_on:
+        prev = b_ref[...]
+        buf = jnp.where(first > 0.5, g, mom * prev + (1.0 - damp) * g)
+        d = g + mom * buf if nesterov else buf
+    else:
+        buf = b_ref[...]
+        d = g
+    if wd_after_momentum:
+        d = d + wd * p
+    d_ref[...] = -lr * d
+    b_out[...] = buf
+
+
+def sgd_update(p, g, buf, wd_col, scalars, nesterov: bool, wd_after_momentum: bool, momentum_on: bool) -> Tuple:
+    """Fused SGD w/ momentum/nesterov/dampening/decay-placement.
+
+    Mirrors the sgd functor (reference: csrc/multi_tensor_sgd_kernel.cu,
+    apex/optimizers/fused_sgd.py:6-227): first momentum application sets
+    buf = d; `wd_after_momentum` reproduces the reference's
+    materialize-order option. Returns (delta_p_f32, new_buf).
+    """
+    kern = functools.partial(_sgd_kernel, nesterov, wd_after_momentum, momentum_on)
+    return _call(kern, [p, g, buf], [wd_col], scalars, [jnp.float32, buf.dtype])
+
+
+# ---------------------------------------------------------------------------
+# Adagrad          scalars: [lr, eps, grad_scale]
+# ---------------------------------------------------------------------------
+
+
+def _adagrad_kernel(adagrad_w_mode, p_ref, g_ref, h_ref, wd_ref, s_ref, d_ref, h_out):
+    lr, eps, gs = (s_ref[0, i] for i in range(3))
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32) * gs
+    wd = wd_ref[...]
+    if not adagrad_w_mode:
+        g = g + wd * p
+    h = h_ref[...] + g * g
+    update = g / (jnp.sqrt(h) + eps)
+    if adagrad_w_mode:
+        update = update + wd * p
+    d_ref[...] = -lr * update
+    h_out[...] = h
+
+
+def adagrad_update(p, g, h, wd_col, scalars, adagrad_w_mode: bool) -> Tuple:
+    """Fused Adagrad (reference: csrc/multi_tensor_adagrad.cu:100,
+    apex/optimizers/fused_adagrad.py:5-121). Returns (delta_p_f32, new_h)."""
+    kern = functools.partial(_adagrad_kernel, adagrad_w_mode)
+    return _call(kern, [p, g, h], [wd_col], scalars, [jnp.float32, h.dtype])
+
+
+# ---------------------------------------------------------------------------
+# NovoGrad         scalars: [lr, beta1, beta3, eps, bc1, bc2, grad_scale]
+#   v (per-tensor blended grad-NORM, not squared) arrives as a (rows,1)
+#   column already EMA-updated by the optimizer layer (the reference blends
+#   host-side via multi_tensor_norm_out_cuda, multi_tensor_novograd.cu:161-164).
+# ---------------------------------------------------------------------------
+
+
+def _novograd_kernel(reg_inside_moment, p_ref, g_ref, m_ref, vcol_ref, wd_ref, s_ref, d_ref, m_out):
+    lr, b1, b3, eps, bc1, bc2, gs = (s_ref[0, i] for i in range(7))
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32) * gs
+    wd = wd_ref[...]
+    denom = vcol_ref[...] / bc2 + eps  # (B,1) broadcast; v IS the norm
+    if reg_inside_moment:  # MOMENT_MODE_0 (multi_tensor_novograd.cu:99-105)
+        m = b1 * m_ref[...] + b3 * (g / denom + wd * p)
+        d_ref[...] = -lr * (m / bc1)
+    else:  # MOMENT_MODE_1, decoupled decay (:107-114)
+        m = b1 * m_ref[...] + b3 * g
+        d_ref[...] = -lr * ((m / bc1) / denom + wd * p)
+    m_out[...] = m
+
+
+def novograd_update(p, g, m, v_col, wd_col, scalars, reg_inside_moment: bool) -> Tuple:
+    """Fused NovoGrad update given the blended per-tensor norm column.
+
+    Mirrors the novograd functor exactly (reference:
+    csrc/multi_tensor_novograd.cu:55-125, apex/optimizers/fused_novograd.py):
+    denom = v_unbiased + eps with v holding the *norm*; beta3 = 1-beta1
+    under grad averaging. Returns (delta_p_f32, new_m).
+    """
+    kern = functools.partial(_novograd_kernel, reg_inside_moment)
+    return _call(
+        kern, [p, g, m], [v_col, wd_col], scalars, [jnp.float32, m.dtype]
+    )
+
+
+# ---------------------------------------------------------------------------
+# LAMB stage 1     scalars: [beta1, beta2, beta3, eps, bc1, bc2, grad_scale, clip]
+#   emits the Adam-style update direction u + new moments; stage 2 applies
+#   the per-tensor trust ratio computed outside from ||p|| and ||u||.
+#   beta3 = 1-beta1 under grad averaging, else 1 (reference fused_lamb.py:87).
+# ---------------------------------------------------------------------------
+
+
+def _lamb1_kernel(adam_w_mode, p_ref, g_ref, m_ref, v_ref, wd_ref, s_ref, u_ref, m_out, v_out):
+    b1, b2, b3, eps, bc1, bc2, gs, clip = (s_ref[0, i] for i in range(8))
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32) * gs * clip
+    wd = wd_ref[...]
+    if not adam_w_mode:  # MODE_0: decay into the scaled grad (lamb.cu:124-132)
+        g = g + wd * p
+    m = b1 * m_ref[...] + b3 * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode:  # MODE_1: decay in the update (lamb.cu:135-141)
+        u = u + wd * p
+    u_ref[...] = u
+    m_out[...] = m
+    v_out[...] = v
+
+
+def lamb_stage1(p, g, m, v, wd_col, scalars, adam_w_mode: bool) -> Tuple:
+    """LAMB reduction stage (reference: csrc/multi_tensor_lamb.cu stage 1,
+    apex/optimizers/fused_lamb.py:96-171): produces the un-trust-scaled
+    update direction and new moments. `clip` in scalars is the global
+    grad-norm clip factor max/||g|| (reference lamb.cu:66 divides by the
+    reciprocal). Returns (u_f32, new_m, new_v)."""
+    kern = functools.partial(_lamb1_kernel, adam_w_mode)
+    return _call(
+        kern, [p, g, m, v], [wd_col], scalars, [jnp.float32, m.dtype, v.dtype]
+    )
+
+
+def _lamb2_kernel(u_ref, ratio_ref, s_ref, d_ref):
+    lr = s_ref[0, 0]
+    d_ref[...] = -lr * ratio_ref[...] * u_ref[...]
+
+
+def lamb_stage2(u, ratio_col, scalars) -> Tuple:
+    """LAMB update stage: delta = -lr * trust_ratio * u
+    (reference: csrc/multi_tensor_lamb.cu stage 2). Returns (delta_p_f32,)."""
+    return _call(_lamb2_kernel, [u], [ratio_col], scalars, [jnp.float32])

@@ -46,6 +46,7 @@ __all__ = [
     "pack_like",
     "unpack_tree",
     "group_segment_ids",
+    "respec",
 ]
 
 WIDTH = SUBLANE * LANE  # 1024: one fp32 VREG worth of elements per row
@@ -210,6 +211,28 @@ def unpack_tree(packed: PackedTree) -> Any:
             leaf = jax.lax.dynamic_slice_in_dim(flat, start, ls.numel)
             leaves[i] = leaf.reshape(ls.shape)
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+def respec(spec: PackSpec, dtype) -> PackSpec:
+    """A PackSpec with identical layout but every group/leaf in `dtype`.
+
+    Used to pack companion trees (fp32 grads, fp32 moments) row-aligned
+    with a low-precision parameter packing — the packed analogue of the
+    reference's separate fp32 master/moment tensor lists
+    (reference: apex/amp/_process_optimizer.py:28-90).
+    """
+    if dtype is None:
+        return spec
+    name = jnp.dtype(dtype).name
+    return spec._replace(
+        groups=tuple(
+            g._replace(
+                dtype=name,
+                leaf_specs=tuple(ls._replace(dtype=name) for ls in g.leaf_specs),
+            )
+            for g in spec.groups
+        )
+    )
 
 
 @functools.lru_cache(maxsize=64)

@@ -1,0 +1,187 @@
+"""Scaled masked / causal softmax Pallas kernels.
+
+TPU-native equivalent of the megatron fused softmax kernels
+(reference: csrc/megatron/scaled_masked_softmax*.{cpp,h,cu},
+scaled_upper_triang_masked_softmax*, surfaced through
+apex/transformer/functional/fused_softmax.py:21-93). Unlike the
+reference's warp-tiled kernels, there is NO seqlen ≤ 2048 ceiling
+(reference: fused_softmax.py:160) — blocks tile the row dimension and
+the key dimension stays resident in VMEM (up to ~16K keys fp32).
+
+Math is fp32 with max-subtraction; masked positions contribute -10000
+like the reference kernels. Backward is the fused softmax-grad
+y*(dy - Σ dy·y) (reference backward kernels), wired via custom_vjp.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from rocm_apex_tpu.ops._pallas import kernel_dtype, pad_rows, pallas_call, row_block
+
+__all__ = ["scaled_upper_triang_masked_softmax", "scaled_masked_softmax"]
+
+MASK_FILL = -10000.0  # reference: scaled_masked_softmax.h applies -10000
+
+
+def _block_rows(sk: int) -> int:
+    return row_block(sk)
+
+
+def _pad_axis(x, axis, mult):
+    return pad_rows(x, mult, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# causal (upper-triangular masked)
+# ---------------------------------------------------------------------------
+
+
+def _causal_fwd_kernel(scale, block, sq, x_ref, y_ref):
+    s = pl.program_id(1)
+    x = x_ref[...].astype(jnp.float32) * scale  # (1, block, sk)
+    sk = x.shape[-1]
+    row = s * block + jax.lax.broadcasted_iota(jnp.int32, (1, block, sk), 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, block, sk), 2)
+    # causal: key j attends only to queries i >= j (j <= i); also mask the
+    # row padding beyond sq so padded rows stay finite
+    masked = (col > row) | (row >= sq)
+    x = jnp.where(masked, MASK_FILL, x)
+    x = x - jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x)
+    y_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(y_ref.dtype)
+
+
+def _softmax_bwd_kernel(scale, y_ref, dy_ref, dx_ref):
+    y = y_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    s = jnp.sum(y * dy, axis=-1, keepdims=True)
+    dx_ref[...] = (scale * y * (dy - s)).astype(dx_ref.dtype)
+
+
+def _causal_fwd_impl(x, scale):
+    b, sq, sk = x.shape
+    block = _block_rows(sk)
+    xp = _pad_axis(x, 1, block)
+    sqp = xp.shape[1]
+    grid = (b, sqp // block)
+    y = pallas_call(
+        functools.partial(_causal_fwd_kernel, scale, block, sq),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, block, sk), lambda i, s: (i, s, 0))],
+        out_specs=pl.BlockSpec((1, block, sk), lambda i, s: (i, s, 0)),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, kernel_dtype(x.dtype)),
+    )(xp.astype(kernel_dtype(x.dtype)))
+    return y[:, :sq].astype(x.dtype)
+
+
+def _softmax_bwd_impl(y, dy, scale):
+    shape = y.shape
+    sk = shape[-1]
+    y2 = y.reshape(-1, sk)
+    dy2 = dy.reshape(-1, sk)
+    block = _block_rows(sk)
+    y2 = _pad_axis(y2, 0, block)
+    dy2 = _pad_axis(dy2, 0, block)
+    rows = y2.shape[0]
+    dx = pallas_call(
+        functools.partial(_softmax_bwd_kernel, scale),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, sk), lambda i: (i, 0)),
+            pl.BlockSpec((block, sk), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, sk), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, sk), kernel_dtype(y.dtype)),
+    )(y2.astype(kernel_dtype(y.dtype)), dy2.astype(kernel_dtype(dy.dtype)))
+    n = 1
+    for d in shape[:-1]:
+        n *= d
+    return dx[:n].reshape(shape).astype(y.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scaled_upper_triang_masked_softmax(x, scale):
+    """softmax(scale·x) with causal masking on (b, sq, sk) inputs.
+
+    Semantics of `ScaledUpperTriangMaskedSoftmax`
+    (reference: apex/transformer/functional/fused_softmax.py:21-64 +
+    csrc/megatron/scaled_upper_triang_masked_softmax.h), without the
+    seqlen ceiling.
+    """
+    return _causal_fwd_impl(x, scale)
+
+
+def _causal_vjp_fwd(x, scale):
+    y = _causal_fwd_impl(x, scale)
+    return y, y
+
+
+def _causal_vjp_bwd(scale, y, dy):
+    return (_softmax_bwd_impl(y, dy, scale),)
+
+
+scaled_upper_triang_masked_softmax.defvjp(_causal_vjp_fwd, _causal_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# padding-masked
+# ---------------------------------------------------------------------------
+
+
+def _masked_fwd_kernel(scale, x_ref, m_ref, y_ref):
+    x = x_ref[...].astype(jnp.float32) * scale  # (1, 1, block, sk)
+    masked = m_ref[...] != 0
+    x = jnp.where(masked, MASK_FILL, x)
+    x = x - jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x)
+    y_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(y_ref.dtype)
+
+
+def _masked_fwd_impl(x, mask, scale):
+    b, h, sq, sk = x.shape
+    if mask.shape != (b, 1, sq, sk):
+        mask = jnp.broadcast_to(mask, (b, 1, sq, sk))
+    block = _block_rows(sk)
+    xp = _pad_axis(x, 2, block)
+    mp = _pad_axis(mask.astype(jnp.int32), 2, block)
+    sqp = xp.shape[2]
+    grid = (b, h, sqp // block)
+    y = pallas_call(
+        functools.partial(_masked_fwd_kernel, scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block, sk), lambda i, j, s: (i, j, s, 0)),
+            pl.BlockSpec((1, 1, block, sk), lambda i, j, s: (i, 0, s, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block, sk), lambda i, j, s: (i, j, s, 0)),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, kernel_dtype(x.dtype)),
+    )(xp.astype(kernel_dtype(x.dtype)), mp)
+    return y[:, :, :sq].astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scaled_masked_softmax(x, mask, scale):
+    """softmax(scale·x masked_fill mask) on (b, np, sq, sk) inputs.
+
+    Semantics of `ScaledMaskedSoftmax` (reference:
+    apex/transformer/functional/fused_softmax.py:67-93 +
+    csrc/megatron/scaled_masked_softmax.h): `mask` is boolean with True =
+    masked-out, broadcast over heads from (b, 1, sq, sk).
+    """
+    return _masked_fwd_impl(x, mask, scale)
+
+
+def _masked_vjp_fwd(x, mask, scale):
+    y = _masked_fwd_impl(x, mask, scale)
+    return y, y
+
+
+def _masked_vjp_bwd(scale, y, dy):
+    return _softmax_bwd_impl(y, dy, scale), None
+
+
+scaled_masked_softmax.defvjp(_masked_vjp_fwd, _masked_vjp_bwd)

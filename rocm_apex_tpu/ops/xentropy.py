@@ -1,0 +1,129 @@
+"""Fused label-smoothing softmax cross-entropy in Pallas.
+
+TPU-native equivalent of the xentropy extension
+(reference: apex/contrib/csrc/xentropy/xentropy_kernel.cu:726, surfaced
+by apex/contrib/xentropy/softmax_xentropy.py): the forward fuses
+max/logsumexp/target-gather into one pass and saves only
+``max_log_sum_exp`` (NOT the softmax — the reference's memory trick),
+the backward recomputes probabilities from logits + lse:
+
+    loss_i = lse_i - (1-eps)·x[i, y_i] - (eps/K)·Σ_j x[i, j]
+    dx_ij  = dL_i · (softmax_ij - (1-eps)·onehot - eps/K)
+
+Rows whose label equals ``padding_idx`` produce zero loss and zero grad
+(reference softmax_xentropy.py:9,22).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from rocm_apex_tpu.ops._pallas import kernel_dtype, pallas_call, row_block
+from rocm_apex_tpu.ops._pallas import pad_rows as _pad_rows
+
+__all__ = ["softmax_cross_entropy_loss"]
+
+
+def _block_rows(vocab: int) -> int:
+    return row_block(vocab)
+
+
+def _fwd_kernel(smoothing, x_ref, lbl_ref, loss_ref, lse_ref):
+    x = x_ref[...].astype(jnp.float32)  # (B, V)
+    lbl = lbl_ref[...]  # (B, 1) int32
+    vocab = x.shape[1]
+    m = jnp.max(x, axis=1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True))
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    xt = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=1, keepdims=True)
+    loss = lse - (1.0 - smoothing) * xt
+    if smoothing > 0.0:
+        loss = loss - (smoothing / vocab) * jnp.sum(x, axis=1, keepdims=True)
+    loss_ref[...] = loss
+    lse_ref[...] = lse
+
+
+def _bwd_kernel(smoothing, x_ref, lbl_ref, lse_ref, dl_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    lbl = lbl_ref[...]
+    lse = lse_ref[...]
+    dl = dl_ref[...]
+    vocab = x.shape[1]
+    probs = jnp.exp(x - lse)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    target = jnp.where(col == lbl, 1.0 - smoothing, 0.0) + smoothing / vocab
+    dx_ref[...] = (dl * (probs - target)).astype(dx_ref.dtype)
+
+
+def _fwd_impl(logits, labels, smoothing):
+    rows0, vocab = logits.shape
+    block = _block_rows(vocab)
+    xp = _pad_rows(logits, block)
+    lbl = _pad_rows(labels.astype(jnp.int32).reshape(-1, 1), block)
+    rows = xp.shape[0]
+    loss, lse = pallas_call(
+        functools.partial(_fwd_kernel, smoothing),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, vocab), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+    )(xp.astype(kernel_dtype(xp.dtype)), lbl)
+    return loss[:rows0, 0], lse[:rows0, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0):
+    """Per-row smoothed CE losses on (rows, vocab) logits.
+
+    API of `SoftmaxCrossEntropyLoss.apply`
+    (reference: apex/contrib/xentropy/softmax_xentropy.py:4-28); returns
+    fp32 losses (the reference's `half_to_float=True` behavior, which is
+    the only sensible mode on TPU).
+    """
+    loss, _ = _fwd_impl(logits, labels, smoothing)
+    return jnp.where(labels == padding_idx, 0.0, loss)
+
+
+def _vjp_fwd(logits, labels, smoothing, padding_idx):
+    loss, lse = _fwd_impl(logits, labels, smoothing)
+    loss = jnp.where(labels == padding_idx, 0.0, loss)
+    return loss, (logits, labels, lse)
+
+
+def _vjp_bwd(smoothing, padding_idx, res, dloss):
+    logits, labels, lse = res
+    rows0, vocab = logits.shape
+    dloss = jnp.where(labels == padding_idx, 0.0, dloss)
+    block = _block_rows(vocab)
+    xp = _pad_rows(logits, block)
+    lbl = _pad_rows(labels.astype(jnp.int32).reshape(-1, 1), block)
+    lse_p = _pad_rows(lse.reshape(-1, 1), block)
+    dl_p = _pad_rows(dloss.astype(jnp.float32).reshape(-1, 1), block)
+    rows = xp.shape[0]
+    dx = pallas_call(
+        functools.partial(_bwd_kernel, smoothing),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, vocab), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, vocab), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, vocab), kernel_dtype(logits.dtype)),
+    )(xp.astype(kernel_dtype(xp.dtype)), lbl, lse_p, dl_p)
+    return dx[:rows0].astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_vjp_fwd, _vjp_bwd)

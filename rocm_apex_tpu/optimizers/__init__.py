@@ -1,0 +1,43 @@
+"""Fused optimizers (reference: apex/optimizers/__init__.py:1-6).
+
+Each exists in two shapes: an optax `GradientTransformation` factory
+(`fused_adam(...)`) for functional pipelines, and an apex-style class
+(`FusedAdam`) with `init`/`step`. All run one Pallas update kernel per
+dtype bucket over packed pytree buffers (ops/packing.py, ops/optim_kernels.py).
+"""
+
+from rocm_apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamState, fused_adam
+from rocm_apex_tpu.optimizers.fused_adagrad import (
+    FusedAdagrad,
+    FusedAdagradState,
+    fused_adagrad,
+)
+from rocm_apex_tpu.optimizers.fused_lamb import FusedLAMB, FusedLAMBState, fused_lamb
+from rocm_apex_tpu.optimizers.fused_mixed_precision_lamb import (
+    FusedMixedPrecisionLamb,
+)
+from rocm_apex_tpu.optimizers.fused_novograd import (
+    FusedNovoGrad,
+    FusedNovoGradState,
+    fused_novograd,
+)
+from rocm_apex_tpu.optimizers.fused_sgd import FusedSGD, FusedSGDState, fused_sgd
+
+__all__ = [
+    "FusedAdam",
+    "FusedAdamState",
+    "fused_adam",
+    "FusedAdagrad",
+    "FusedAdagradState",
+    "fused_adagrad",
+    "FusedLAMB",
+    "FusedLAMBState",
+    "fused_lamb",
+    "FusedMixedPrecisionLamb",
+    "FusedNovoGrad",
+    "FusedNovoGradState",
+    "fused_novograd",
+    "FusedSGD",
+    "FusedSGDState",
+    "fused_sgd",
+]

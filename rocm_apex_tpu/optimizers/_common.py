@@ -1,0 +1,170 @@
+"""Shared plumbing for the fused optimizer family.
+
+The reference optimizers all follow one pattern: group params by dtype
+{fp16/bf16, fp32} and issue one multi_tensor_applier launch per bucket
+(reference: apex/optimizers/fused_adam.py:117-170). Here the grouping IS
+the packed layout (ops/packing.py): every optimizer packs params once,
+packs grads fp32 into the same row layout, runs one Pallas update per
+dtype-group buffer, and emits optax-style fp32 delta updates.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rocm_apex_tpu.ops.packing import (
+    WIDTH,
+    PackSpec,
+    PackedTree,
+    build_pack_spec,
+    group_segment_ids,
+    pack_like,
+    pack_tree,
+    respec,
+    unpack_tree,
+)
+
+__all__ = [
+    "ScalarOrSchedule",
+    "resolve_lr",
+    "pack_params_and_grads",
+    "wd_columns",
+    "wd_per_tensor",
+    "per_tensor_to_columns",
+    "deltas_to_updates",
+    "zero_group_buffers",
+    "tree_where",
+    "FusedOptimizer",
+]
+
+ScalarOrSchedule = Union[float, jnp.ndarray, Callable]
+
+
+def resolve_lr(lr: ScalarOrSchedule, count):
+    """Accept a constant or an optax-style schedule step→lr."""
+    return lr(count) if callable(lr) else lr
+
+
+def pack_params_and_grads(params: Any, grads: Any):
+    """Pack params (native dtypes) and grads (fp32) into aligned buffers."""
+    spec = build_pack_spec(params)
+    pp = pack_tree(params, spec)
+    pg = pack_like(respec(spec, jnp.float32), grads)
+    return spec, pp, pg
+
+
+def wd_columns(spec: PackSpec, weight_decay, mask: Optional[Any] = None):
+    """Per-group (rows, 1) fp32 weight-decay columns.
+
+    `mask` is a static pytree of bools (True = apply decay) — the
+    functional stand-in for the reference's per-param-group weight_decay
+    (torch param groups, e.g. excluding biases/LN). Rows of masked-out or
+    padding tensors get 0.
+    """
+    mask_leaves = None
+    if mask is not None:
+        mask_leaves = jax.tree_util.tree_leaves(mask)
+        if len(mask_leaves) != spec.n_leaves:
+            raise ValueError(
+                f"weight_decay mask has {len(mask_leaves)} leaves, "
+                f"params have {spec.n_leaves}"
+            )
+    cols = []
+    for g in spec.groups:
+        col = np.zeros((g.rows, 1), np.float32)
+        for i, ls in zip(g.leaf_indices, g.leaf_specs):
+            on = True if mask_leaves is None else bool(mask_leaves[i])
+            if on:
+                col[ls.row_start : ls.row_start + ls.nrows] = 1.0
+        cols.append(jnp.asarray(col) * weight_decay)
+    return cols
+
+
+def wd_per_tensor(spec: PackSpec, weight_decay: float, mask: Optional[Any] = None):
+    """Static per-tensor decay values per group (numpy), for trust-ratio
+    rules that depend on whether a tensor is decayed
+    (reference: csrc/multi_tensor_lamb.cu stage 2 `decay != 0`)."""
+    mask_leaves = None
+    if mask is not None:
+        mask_leaves = jax.tree_util.tree_leaves(mask)
+    out = []
+    for g in spec.groups:
+        vals = np.zeros((len(g.leaf_specs),), np.float32)
+        for j, i in enumerate(g.leaf_indices):
+            on = True if mask_leaves is None else bool(mask_leaves[i])
+            vals[j] = weight_decay if on else 0.0
+        out.append(vals)
+    return out
+
+
+def per_tensor_to_columns(group, values: jnp.ndarray) -> jnp.ndarray:
+    """Spread per-tensor values (n_tensors,) to a (rows, 1) column."""
+    seg = jnp.asarray(group_segment_ids(group))
+    padded = jnp.concatenate([values, jnp.zeros((1,), values.dtype)])
+    return padded[seg][:, None]
+
+
+def per_tensor_sumsq(group, buf: jnp.ndarray) -> jnp.ndarray:
+    """Per-tensor sum of squares of a group buffer via segmented row sums."""
+    from rocm_apex_tpu.ops.multi_tensor import row_sumsq
+
+    row_sq = row_sumsq(buf)[:, 0]
+    seg = jnp.asarray(group_segment_ids(group))
+    return jax.ops.segment_sum(row_sq, seg, num_segments=len(group.leaf_specs) + 1)[
+        : len(group.leaf_specs)
+    ]
+
+
+def deltas_to_updates(spec: PackSpec, deltas) -> Any:
+    """fp32 delta buffers → an optax updates pytree (fp32 leaves).
+
+    `optax.apply_updates` computes (p + u) in promoted fp32 and casts back
+    to p.dtype — identical rounding to the reference's in-kernel fp32 math
+    + final store (csrc/multi_tensor_adam.cu MATH_T accumulators).
+    """
+    return unpack_tree(PackedTree(deltas, respec(spec, jnp.float32)))
+
+
+def zero_group_buffers(spec: PackSpec, dtype=jnp.float32):
+    return tuple(jnp.zeros((g.rows, WIDTH), dtype) for g in spec.groups)
+
+
+def tree_where(pred, new, old):
+    return jax.tree_util.tree_map(lambda n, o: jnp.where(pred, n, o), new, old)
+
+
+class FusedOptimizer:
+    """Apex-style class facade over an optax fused transform.
+
+    Drop-in shape of the reference's `torch.optim.Optimizer` subclasses
+    (reference: apex/optimizers/__init__.py:1-6) restated functionally:
+    ``state = opt.init(params)``, ``params, state = opt.step(params, grads,
+    state)``. `skip` integrates dynamic-loss-scale step skipping: when
+    True, params AND optimizer state are left untouched (the jit-safe
+    analogue of amp's step-patching, reference apex/amp/handle.py:128-154).
+    """
+
+    def __init__(self, tx: optax.GradientTransformation):
+        self.tx = tx
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def step(self, params, grads, state, *, skip=None):
+        updates, new_state = self.tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        if skip is None:
+            return new_params, new_state
+        return (
+            tree_where(skip, params, new_params),
+            tree_where(skip, state, new_state),
+        )
+
+    # optax duck-typing so the class can be passed anywhere a
+    # GradientTransformation is expected (e.g. amp.initialize).
+    @property
+    def update(self):
+        return self.tx.update

@@ -1,0 +1,82 @@
+"""Fused Adagrad over packed buffers.
+
+TPU-native rebuild of `FusedAdagrad` (reference:
+apex/optimizers/fused_adagrad.py:5-121 + csrc/multi_tensor_adagrad.cu:100):
+h += g²; update = g/(√h + eps); `adagrad_w_mode` decouples weight decay
+(reference :30-36).
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import optax
+
+from rocm_apex_tpu.ops import optim_kernels
+from rocm_apex_tpu.optimizers import _common as c
+
+__all__ = ["fused_adagrad", "FusedAdagrad", "FusedAdagradState"]
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    sum: Tuple[jnp.ndarray, ...]  # fp32 accumulator ("sum" in torch Adagrad)
+
+
+def fused_adagrad(
+    learning_rate: c.ScalarOrSchedule = 1e-2,
+    *,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+    weight_decay_mask: Optional[Any] = None,
+    grad_scale: Optional[Any] = None,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        spec = c.build_pack_spec(params)
+        return FusedAdagradState(
+            count=jnp.zeros((), jnp.int32), sum=c.zero_group_buffers(spec)
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params in update()")
+        spec, pp, pg = c.pack_params_and_grads(params, grads)
+        count = state.count + 1
+        lr = c.resolve_lr(learning_rate, count)
+        gs = 1.0 if grad_scale is None else grad_scale
+        wd_cols = c.wd_columns(spec, weight_decay, weight_decay_mask)
+
+        deltas, new_h = [], []
+        for pbuf, gbuf, hbuf, wd in zip(pp.buffers, pg.buffers, state.sum, wd_cols):
+            d, h2 = optim_kernels.adagrad_update(
+                pbuf, gbuf, hbuf, wd, [lr, eps, gs], adagrad_w_mode
+            )
+            deltas.append(d)
+            new_h.append(h2)
+
+        updates = c.deltas_to_updates(spec, deltas)
+        return updates, FusedAdagradState(count=count, sum=tuple(new_h))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedAdagrad(c.FusedOptimizer):
+    """Class facade (reference: apex/optimizers/fused_adagrad.py:5-60)."""
+
+    def __init__(
+        self,
+        lr: c.ScalarOrSchedule = 1e-2,
+        eps: float = 1e-10,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+        weight_decay_mask: Optional[Any] = None,
+    ):
+        super().__init__(
+            fused_adagrad(
+                lr,
+                eps=eps,
+                weight_decay=weight_decay,
+                adagrad_w_mode=adagrad_w_mode,
+                weight_decay_mask=weight_decay_mask,
+            )
+        )

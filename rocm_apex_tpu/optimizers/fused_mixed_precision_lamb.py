@@ -1,0 +1,79 @@
+"""Mixed-precision LAMB with in-step grad-scaler integration.
+
+TPU-native rebuild of `FusedMixedPrecisionLamb` (reference:
+apex/optimizers/fused_mixed_precision_lamb.py:8-256 +
+csrc/multi_tensor_lamb_mp.cu:496): LAMB that operates directly on mixed
+fp32/bf16/fp16 param pytrees, keeps `lr`/`step` as device scalars, and
+consumes the loss scaler's `inv_scale`/`found_inf` inside the step — the
+step counter only advances on non-overflow steps and a skipped step
+leaves params and moments untouched (the reference's
+`_step_supports_amp_scaling` contract).
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+from rocm_apex_tpu.optimizers import _common as c
+from rocm_apex_tpu.optimizers.fused_lamb import FusedLAMBState, fused_lamb
+
+__all__ = ["FusedMixedPrecisionLamb"]
+
+
+class FusedMixedPrecisionLamb:
+    """Scaler-aware LAMB facade (reference constructor :8-74)."""
+
+    def __init__(
+        self,
+        lr: c.ScalarOrSchedule = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        weight_decay_mask: Optional[Any] = None,
+    ):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedMixedPrecisionLamb does not support the AMSGrad variant."
+            )
+        self._kw = dict(
+            bias_correction=bias_correction,
+            betas=betas,
+            eps=eps,
+            weight_decay=weight_decay,
+            grad_averaging=grad_averaging,
+            adam_w_mode=adam_w_mode,
+            max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb,
+            weight_decay_mask=weight_decay_mask,
+        )
+        self.lr = lr
+
+    def init(self, params) -> FusedLAMBState:
+        return fused_lamb(self.lr, **self._kw).init(params)
+
+    def step(
+        self,
+        params,
+        grads,
+        state: FusedLAMBState,
+        *,
+        inv_scale=None,
+        found_inf=None,
+    ):
+        """One step; grads may still carry the loss scale.
+
+        `inv_scale` (1/loss_scale) fuses the unscale into the update
+        kernels; `found_inf` makes the whole step a no-op (params, moments
+        AND the step count — reference fused_mixed_precision_lamb.py:140-256
+        advances `step` only when `found_inf == 0`).
+        """
+        gs = 1.0 if inv_scale is None else inv_scale
+        opt = c.FusedOptimizer(fused_lamb(self.lr, grad_scale=gs, **self._kw))
+        skip = None if found_inf is None else jnp.asarray(found_inf)
+        return opt.step(params, grads, state, skip=skip)
