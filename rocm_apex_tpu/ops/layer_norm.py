@@ -140,7 +140,6 @@ def _ln_bwd_kernel(affine, x_ref, dy_ref, mu_ref, rs_ref, *refs):
         db_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
     else:
         dyg = dy
-    h = x.shape[1]
     c1 = jnp.mean(dyg, axis=1, keepdims=True)
     c2 = jnp.mean(dyg * xhat, axis=1, keepdims=True)
     dx_ref[...] = (rs * (dyg - c1 - xhat * c2)).astype(dx_ref.dtype)

@@ -8,9 +8,12 @@ reference's warp-tiled kernels, there is NO seqlen ≤ 2048 ceiling
 (reference: fused_softmax.py:160) — blocks tile the row dimension and
 the key dimension stays resident in VMEM (up to ~16K keys fp32).
 
-Math is fp32 with max-subtraction; masked positions contribute -10000
-like the reference kernels. Backward is the fused softmax-grad
-y*(dy - Σ dy·y) (reference backward kernels), wired via custom_vjp.
+Math is fp32 with max-subtraction. Mask fills mirror the reference
+kernels: the padding-mask variant fills with -10000
+(scaled_masked_softmax.h) while the causal variant fills with -inf
+(scaled_upper_triang_masked_softmax.h) so future positions get exactly
+zero probability. Backward is the fused softmax-grad y*(dy - Σ dy·y)
+(reference backward kernels), wired via custom_vjp.
 """
 
 import functools
@@ -46,10 +49,11 @@ def _causal_fwd_kernel(scale, block, sq, x_ref, y_ref):
     sk = x.shape[-1]
     row = s * block + jax.lax.broadcasted_iota(jnp.int32, (1, block, sk), 1)
     col = jax.lax.broadcasted_iota(jnp.int32, (1, block, sk), 2)
-    # causal: key j attends only to queries i >= j (j <= i); also mask the
-    # row padding beyond sq so padded rows stay finite
-    masked = (col > row) | (row >= sq)
-    x = jnp.where(masked, MASK_FILL, x)
+    # causal: -inf gives future positions exactly zero probability
+    # (reference scaled_upper_triang_masked_softmax.h); the row padding
+    # beyond sq uses a finite fill so padded rows don't produce 0/0 NaNs
+    x = jnp.where(col > row, -jnp.inf, x)
+    x = jnp.where(row >= sq, MASK_FILL, x)
     x = x - jnp.max(x, axis=-1, keepdims=True)
     e = jnp.exp(x)
     y_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(y_ref.dtype)

@@ -7,5 +7,6 @@ Mirrors the reference `apex.transformer` package layout
 """
 
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.transformer import tensor_parallel
 
-__all__ = ["parallel_state"]
+__all__ = ["parallel_state", "tensor_parallel"]

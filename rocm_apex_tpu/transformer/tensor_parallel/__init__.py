@@ -1,0 +1,80 @@
+"""Tensor (intra-layer) model parallelism over the ``tensor`` mesh axis.
+
+Public surface mirrors the reference package
+(reference: apex/transformer/tensor_parallel/__init__.py), rebuilt on
+shard_map + XLA collectives.
+"""
+
+from rocm_apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from rocm_apex_tpu.transformer.tensor_parallel.data import broadcast_data
+from rocm_apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from rocm_apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from rocm_apex_tpu.transformer.tensor_parallel.memory import (
+    MemoryBuffer,
+    RingMemBuffer,
+    allocate_mem_buff,
+)
+from rocm_apex_tpu.transformer.tensor_parallel.random import (
+    CheckpointPolicy,
+    RngStateTracker,
+    checkpoint,
+    get_cuda_rng_tracker,
+    get_rng_tracker,
+    model_parallel_cuda_manual_seed,
+    model_parallel_prng_keys,
+    model_parallel_seed,
+)
+from rocm_apex_tpu.transformer.utils import (
+    VocabUtility,
+    divide,
+    ensure_divisibility,
+    gather_split_1d_tensor,
+    split_tensor_along_last_dim,
+    split_tensor_into_1d_equal_chunks,
+)
+
+__all__ = [
+    "vocab_parallel_cross_entropy",
+    "broadcast_data",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "MemoryBuffer",
+    "RingMemBuffer",
+    "allocate_mem_buff",
+    "CheckpointPolicy",
+    "RngStateTracker",
+    "checkpoint",
+    "get_rng_tracker",
+    "get_cuda_rng_tracker",
+    "model_parallel_seed",
+    "model_parallel_cuda_manual_seed",
+    "model_parallel_prng_keys",
+    "VocabUtility",
+    "divide",
+    "ensure_divisibility",
+    "split_tensor_along_last_dim",
+    "split_tensor_into_1d_equal_chunks",
+    "gather_split_1d_tensor",
+]

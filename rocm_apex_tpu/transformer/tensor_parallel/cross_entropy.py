@@ -1,0 +1,94 @@
+"""Vocab-parallel softmax cross-entropy.
+
+TPU-native rebuild of the reference's two-allreduce parallel CE
+(reference: apex/transformer/tensor_parallel/cross_entropy.py:23-103):
+
+    1. local max        → pmax over the tensor axis
+    2. local sum-exp    → psum
+    3. target-logit gather with vocab-range masking → psum
+
+The backward matches the reference's saved-softmax gradient
+(cross_entropy.py:76-100) via custom_vjp: d logits = softmax - onehot.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.transformer.utils import VocabUtility
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def _fwd_impl(vocab_parallel_logits, target, axis_name):
+    tp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    partition_vocab_size = vocab_parallel_logits.shape[-1]
+    start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
+        partition_vocab_size, rank, tp
+    )
+
+    # 1. global max for stability (reference :30-35)
+    logits_max = jax.lax.pmax(
+        jnp.max(vocab_parallel_logits, axis=-1), axis_name
+    )
+    logits = vocab_parallel_logits - logits_max[..., None]
+
+    # 3. this rank's slice of the target logit, masked outside the local
+    # vocab range (reference :37-56)
+    local_target = target - start
+    in_range = (local_target >= 0) & (local_target < partition_vocab_size)
+    local_target_clamped = jnp.clip(local_target, 0, partition_vocab_size - 1)
+    predicted = jnp.take_along_axis(
+        logits, local_target_clamped[..., None], axis=-1
+    )[..., 0]
+    predicted = jnp.where(in_range, predicted, 0.0)
+    predicted = jax.lax.psum(predicted, axis_name)
+
+    # 2. global sum-exp (reference :58-63)
+    exp_logits = jnp.exp(logits)
+    sum_exp = jax.lax.psum(jnp.sum(exp_logits, axis=-1), axis_name)
+
+    loss = jnp.log(sum_exp) - predicted
+    softmax = exp_logits / sum_exp[..., None]
+    residuals = (softmax, in_range, local_target_clamped)
+    return loss, residuals
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def vocab_parallel_cross_entropy(vocab_parallel_logits, target, axis_name=None):
+    """Per-token CE loss from vocab-sharded logits.
+
+    Args:
+      vocab_parallel_logits: fp32 ``(..., vocab/tp)`` local logits.
+      target: integer ``(...)`` global token ids.
+      axis_name: TP mesh axis (default: parallel_state tensor axis).
+        Must be bound (shard_map).
+
+    Returns the un-reduced loss, shape ``(...)`` — same contract as the
+    reference (cross_entropy.py:101-103: "The losses are not reduced").
+    """
+    axis_name = parallel_state.TENSOR_AXIS if axis_name is None else axis_name
+    loss, _ = _fwd_impl(vocab_parallel_logits, target, axis_name)
+    return loss
+
+
+def _ce_fwd(vocab_parallel_logits, target, axis_name):
+    axis = parallel_state.TENSOR_AXIS if axis_name is None else axis_name
+    loss, residuals = _fwd_impl(vocab_parallel_logits, target, axis)
+    return loss, residuals
+
+
+def _ce_bwd(axis_name, residuals, g):
+    softmax, in_range, local_target_clamped = residuals
+    # grad = (softmax - onehot_local_target) * g  (reference :76-100)
+    onehot = jax.nn.one_hot(
+        local_target_clamped, softmax.shape[-1], dtype=softmax.dtype
+    ) * in_range[..., None].astype(softmax.dtype)
+    grad = (softmax - onehot) * g[..., None]
+    return (grad, None)
+
+
+vocab_parallel_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

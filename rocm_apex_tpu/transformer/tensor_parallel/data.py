@@ -1,0 +1,59 @@
+"""Cross-rank broadcast of a keyed batch dict.
+
+TPU-native rebuild of `broadcast_data`
+(reference: apex/transformer/tensor_parallel/data.py:77-113). The
+reference sends size metadata then one flattened payload from TP rank 0
+to the other TP ranks with NCCL broadcast. Under shard_map the same
+semantic is one masked psum: every rank contributes zeros except rank 0.
+In the common single-controller case where the batch is already
+replicated this compiles away; it matters when each TP rank loads
+different data (e.g. per-host loaders) and must agree.
+"""
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.transformer import parallel_state
+
+__all__ = ["broadcast_data"]
+
+
+def _check_data_types(keys: List[str], data: Dict[str, jnp.ndarray], target_dtype):
+    """Reference data.py:17-26."""
+    for key in keys:
+        if data[key].dtype != target_dtype:
+            raise ValueError(
+                f"{key} has data type {data[key].dtype} which "
+                f"is different than {target_dtype}"
+            )
+
+
+def broadcast_data(
+    keys: List[str],
+    data: Dict[str, jnp.ndarray],
+    dtype,
+    axis_name: str = None,
+) -> Dict[str, jnp.ndarray]:
+    """Broadcast each `data[key]` from rank 0 of the TP axis.
+
+    Must run inside shard_map with the axis bound. Shapes must already
+    agree across ranks (the reference broadcasts the size metadata too —
+    data.py:27-55 — which a single-controller SPMD program guarantees
+    statically).
+    """
+    axis_name = parallel_state.TENSOR_AXIS if axis_name is None else axis_name
+    _check_data_types(keys, data, dtype)
+    rank = jax.lax.axis_index(axis_name)
+    is_src = (rank == 0)
+    out = {}
+    for key in keys:
+        x = data[key]
+        # Masked psum == broadcast-from-0 (one ICI collective for all
+        # practical payloads; the reference packs keys into one flat
+        # buffer for the same latency reason, data.py:88-106).
+        contrib = jnp.where(is_src, x, jnp.zeros_like(x))
+        summed = jax.lax.psum(contrib, axis_name)
+        out[key] = summed.astype(dtype)
+    return out

@@ -1,0 +1,263 @@
+"""Tensor-parallel layers: vocab-parallel embedding, column/row-parallel
+linear.
+
+TPU-native rebuild of the reference's Megatron-style TP layers
+(reference: apex/transformer/tensor_parallel/layers.py:127-477). Flax
+modules holding the *local shard* of each weight, meant to run inside
+`shard_map` over the ``tensor`` mesh axis; the collective edges come from
+``mappings``. Differences from the reference, by design:
+
+* weights use the JAX ``(in, out)`` convention, not torch's ``(out, in)``;
+* partitioned init = fold the TP rank into the PRNG key (the functional
+  equivalent of ``_initialize_affine_weight_gpu``'s per-rank RNG fork,
+  reference layers.py:78-124) — no master-weight scatter is needed since
+  every rank derives its shard deterministically;
+* the async-allreduce fused autograd function
+  (reference layers.py:206-240) has no analogue: XLA's latency-hiding
+  scheduler overlaps the backward psum with the weight-gradient matmul
+  automatically, so ``no_async_tensor_model_parallel_allreduce`` is
+  accepted for API parity and ignored;
+* ``use_cpu_initialization`` is meaningless (init is a traced function).
+
+For the GSPMD path (pjit + sharding annotations instead of shard_map) use
+the same modules with ``world_size=1`` and annotate the full weights —
+see ``rocm_apex_tpu.models.gpt``.
+"""
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.transformer.tensor_parallel import mappings
+from rocm_apex_tpu.transformer.utils import VocabUtility, divide
+
+__all__ = [
+    "VocabParallelEmbedding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+]
+
+Initializer = Callable[..., jnp.ndarray]
+
+
+def _axis_rank(axis_name: str):
+    """Rank on `axis_name`, or None when the axis is not bound (tp=1 /
+    GSPMD usage outside shard_map)."""
+    try:
+        return jax.lax.axis_index(axis_name)
+    except NameError:
+        return None
+
+
+def _sharded_init(init_fn: Initializer, axis_name: str) -> Initializer:
+    """Per-rank partitioned init: fold the TP rank into the key so each
+    shard draws independent values (reference layers.py:105-124 forks the
+    CUDA RNG per rank for the same purpose)."""
+
+    def wrapped(key, shape, dtype):
+        rank = _axis_rank(axis_name)
+        if rank is not None:
+            key = jax.random.fold_in(key, rank)
+        return init_fn(key, shape, dtype)
+
+    return wrapped
+
+
+def _resolve_world_size(world_size: Optional[int]) -> int:
+    if world_size is not None:
+        return world_size
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_tensor_model_parallel_world_size()
+    return 1
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding sharded along the vocabulary dimension.
+
+    Reference: apex/transformer/tensor_parallel/layers.py:127-205. Out-of
+    -range ids are masked locally; the partial lookups are summed with a
+    psum (layers.py:179-205).
+
+    Attributes:
+      num_embeddings: global vocab size.
+      embedding_dim: hidden size.
+      init_method: weight initializer (reference default: xavier normal).
+      params_dtype: weight storage dtype.
+      dtype: compute/output dtype.
+      world_size: TP degree; defaults to the active parallel_state.
+      axis_name: mesh axis to reduce over.
+    """
+
+    num_embeddings: int
+    embedding_dim: int
+    init_method: Initializer = nn.initializers.normal(stddev=0.02)
+    params_dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype = jnp.float32
+    world_size: Optional[int] = None
+    axis_name: str = parallel_state.TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+        tp = _resolve_world_size(self.world_size)
+        per_partition = divide(self.num_embeddings, tp)
+        weight = self.param(
+            "weight",
+            _sharded_init(self.init_method, self.axis_name),
+            (per_partition, self.embedding_dim),
+            self.params_dtype,
+        )
+        if tp == 1:
+            return jnp.take(weight, ids, axis=0).astype(self.dtype)
+
+        rank = _axis_rank(self.axis_name)
+        if rank is None:
+            raise ValueError(
+                f"VocabParallelEmbedding with world_size={tp} must run "
+                f"inside shard_map with axis {self.axis_name!r} bound"
+            )
+        start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, tp
+        )
+        # Mask ids outside [start, end), clamp the local index, zero the
+        # masked rows, then sum partial embeddings across TP
+        # (reference layers.py:179-205).
+        local = ids - start
+        in_range = (local >= 0) & (local < per_partition)
+        local = jnp.clip(local, 0, per_partition - 1)
+        out = jnp.take(weight, local, axis=0).astype(self.dtype)
+        out = jnp.where(in_range[..., None], out, 0)
+        return mappings.reduce_from_tensor_model_parallel_region(
+            out, self.axis_name
+        )
+
+
+class ColumnParallelLinear(nn.Module):
+    """Linear with the output dimension sharded: Y = XA + b, A split
+    column-wise; each rank computes its slice of Y.
+
+    Reference: apex/transformer/tensor_parallel/layers.py:243-362.
+    ``gather_output`` all-gathers Y at the end (layers.py:252-255);
+    ``skip_bias_add`` returns the bias instead of adding it so a later
+    kernel can fuse it (layers.py:258-262).
+
+    Returns ``(output, output_bias)`` exactly like the reference; when
+    ``skip_bias_add=False`` output_bias is None.
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    gather_output: bool = True
+    init_method: Initializer = nn.initializers.lecun_normal()
+    bias_init: Initializer = nn.initializers.zeros_init()
+    skip_bias_add: bool = False
+    params_dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype = jnp.float32
+    world_size: Optional[int] = None
+    axis_name: str = parallel_state.TENSOR_AXIS
+    # Accepted for API parity; XLA overlaps the backward psum on its own
+    # (reference layers.py:206-240, 296-300).
+    no_async_tensor_model_parallel_allreduce: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+        tp = _resolve_world_size(self.world_size)
+        out_per_partition = divide(self.output_size, tp)
+        kernel = self.param(
+            "kernel",
+            _sharded_init(self.init_method, self.axis_name),
+            (self.input_size, out_per_partition),
+            self.params_dtype,
+        )
+        bias = (
+            self.param(
+                "bias",
+                _sharded_init(self.bias_init, self.axis_name),
+                (out_per_partition,),
+                self.params_dtype,
+            )
+            if self.use_bias
+            else None
+        )
+
+        if tp > 1:
+            x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
+        y = jnp.dot(
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
+            preferred_element_type=self.dtype,
+        )
+        out_bias = None
+        if bias is not None:
+            if self.skip_bias_add:
+                out_bias = bias.astype(self.dtype)
+            else:
+                y = y + bias.astype(self.dtype)
+        if self.gather_output and tp > 1:
+            y = mappings.gather_from_tensor_model_parallel_region(y, self.axis_name)
+            if out_bias is not None:
+                out_bias = mappings.gather_from_tensor_model_parallel_region(
+                    out_bias, self.axis_name
+                )
+        return y, out_bias
+
+
+class RowParallelLinear(nn.Module):
+    """Linear with the input dimension sharded: Y = XA + b, A split
+    row-wise; partial products are psum-reduced.
+
+    Reference: apex/transformer/tensor_parallel/layers.py:365-477.
+    ``input_is_parallel`` skips the input scatter when the producer was a
+    ColumnParallelLinear with gather_output=False (layers.py:378-381).
+    Bias is added after the reduction, once (layers.py:461-470).
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    input_is_parallel: bool = False
+    init_method: Initializer = nn.initializers.lecun_normal()
+    bias_init: Initializer = nn.initializers.zeros_init()
+    skip_bias_add: bool = False
+    params_dtype: jnp.dtype = jnp.float32
+    dtype: jnp.dtype = jnp.float32
+    world_size: Optional[int] = None
+    axis_name: str = parallel_state.TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+        tp = _resolve_world_size(self.world_size)
+        in_per_partition = divide(self.input_size, tp)
+        kernel = self.param(
+            "kernel",
+            _sharded_init(self.init_method, self.axis_name),
+            (in_per_partition, self.output_size),
+            self.params_dtype,
+        )
+        # Bias is replicated, not sharded: plain init (reference
+        # layers.py:431-439).
+        bias = (
+            self.param("bias", self.bias_init, (self.output_size,), self.params_dtype)
+            if self.use_bias
+            else None
+        )
+
+        if tp > 1 and not self.input_is_parallel:
+            x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
+        y = jnp.dot(
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
+            preferred_element_type=self.dtype,
+        )
+        if tp > 1:
+            y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
+        out_bias = None
+        if bias is not None:
+            if self.skip_bias_add:
+                out_bias = bias.astype(self.dtype)
+            else:
+                y = y + bias.astype(self.dtype)
+        return y, out_bias

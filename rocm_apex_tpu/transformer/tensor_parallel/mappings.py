@@ -1,0 +1,213 @@
+"""The four tensor-parallel collective autograd primitives.
+
+TPU-native rebuild of the reference's mappings
+(reference: apex/transformer/tensor_parallel/mappings.py:23-159). The
+reference implements each primitive as a torch.autograd.Function over an
+NCCL process group; here each is a `jax.custom_vjp` over a named mesh
+axis, used inside `shard_map`:
+
+    copy    : identity fwd / psum bwd        (mappings.py:77-90)
+    reduce  : psum fwd / identity bwd        (mappings.py:93-106)
+    scatter : split-last-dim fwd / all_gather bwd   (mappings.py:109-122)
+    gather  : all_gather fwd / split-last-dim bwd   (mappings.py:125-138)
+
+XLA compiles the psum/all_gather to ICI collectives; there is no process
+group object — the axis NAME is the group.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.transformer import parallel_state
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+]
+
+
+def _axis(axis_name):
+    return parallel_state.TENSOR_AXIS if axis_name is None else axis_name
+
+
+def _psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def _split_last(x, axis_name):
+    """This rank's 1/N chunk of the last dim (reference mappings.py:36-52)."""
+    n = jax.lax.axis_size(axis_name)
+    chunk = x.shape[-1] // n
+    if chunk * n != x.shape[-1]:
+        raise ValueError(
+            f"last dim {x.shape[-1]} not divisible by axis size {n}"
+        )
+    rank = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
+
+def _gather_last(x, axis_name):
+    """Concatenate the last dim across the axis (reference mappings.py:55-72)."""
+    return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def _split_first(x, axis_name):
+    n = jax.lax.axis_size(axis_name)
+    chunk = x.shape[0] // n
+    if chunk * n != x.shape[0]:
+        raise ValueError(f"first dim {x.shape[0]} not divisible by axis size {n}")
+    rank = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=0)
+
+
+def _gather_first(x, axis_name):
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+# -- copy: identity fwd / allreduce bwd --------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=None):
+    """Input to a column-parallel layer: identity forward, grad-psum
+    backward (reference mappings.py:77-90)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (_psum(g, _axis(axis_name)),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# -- reduce: allreduce fwd / identity bwd ------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=None):
+    """Output of a row-parallel layer: psum forward, identity backward
+    (reference mappings.py:93-106)."""
+    return _psum(x, _axis(axis_name))
+
+
+def _reduce_fwd(x, axis_name):
+    return _psum(x, _axis(axis_name)), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# -- scatter: split fwd / gather bwd -----------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=None):
+    """Split the last dim, keep this rank's chunk (reference
+    mappings.py:109-122)."""
+    return _split_last(x, _axis(axis_name))
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_last(x, _axis(axis_name)), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_gather_last(g, _axis(axis_name)),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# -- gather: gather fwd / split bwd ------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=None):
+    """All-gather the last dim (reference mappings.py:125-138)."""
+    return _gather_last(x, _axis(axis_name))
+
+
+def _gather_fwd(x, axis_name):
+    return _gather_last(x, _axis(axis_name)), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_last(g, _axis(axis_name)),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# -- sequence-parallel region mappings ---------------------------------
+#
+# Capability the reference lacks (SURVEY.md §5: no sequence parallelism);
+# included because it falls out of the same design: activations sharded
+# along the sequence (first) dim between transformer-layer regions, with
+# reduce_scatter/all_gather replacing the plain psum at region edges
+# (Korthikanti et al., "Reducing Activation Recomputation").
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name=None):
+    return _split_first(x, _axis(axis_name))
+
+
+def _sp_scatter_fwd(x, axis_name):
+    return _split_first(x, _axis(axis_name)), None
+
+
+def _sp_scatter_bwd(axis_name, _, g):
+    return (_gather_first(g, _axis(axis_name)),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_sequence_parallel_region(x, axis_name=None):
+    return _gather_first(x, _axis(axis_name))
+
+
+def _sp_gather_fwd(x, axis_name):
+    return _gather_first(x, _axis(axis_name)), None
+
+
+def _sp_gather_bwd(axis_name, _, g):
+    return (jax.lax.psum_scatter(g, _axis(axis_name), scatter_dimension=0, tiled=True),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=None):
+    return jax.lax.psum_scatter(x, _axis(axis_name), scatter_dimension=0, tiled=True)
+
+
+def _sp_rs_fwd(x, axis_name):
+    return (
+        jax.lax.psum_scatter(x, _axis(axis_name), scatter_dimension=0, tiled=True),
+        None,
+    )
+
+
+def _sp_rs_bwd(axis_name, _, g):
+    return (_gather_first(g, _axis(axis_name)),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

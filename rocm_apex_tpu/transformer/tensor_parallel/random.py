@@ -1,0 +1,157 @@
+"""RNG state management + activation checkpointing.
+
+TPU-native rebuild of the reference's CudaRNGStatesTracker + checkpoint
+(reference: apex/transformer/tensor_parallel/random.py:113-293). The
+reference must snapshot/restore device RNG states and replay them inside
+recomputation so dropout masks match between the checkpointed forward and
+the recomputed forward (CheckpointFunction:224-289). JAX's PRNG is
+functional, so *replay is free*: `jax.checkpoint` re-traces the same
+function with the same keys and regenerates bit-identical randomness.
+What remains of the reference's machinery:
+
+* seed bookkeeping — `model_parallel_prng_keys` reproduces the seed
+  offsets of `model_parallel_cuda_manual_seed` (random.py:193-221):
+  tensor-parallel seed = seed + 2718 + tp_rank, data-parallel seed =
+  seed (identical across TP ranks);
+* a named-key tracker for code structured around the reference API
+  (`get_rng_tracker().fork()`), implemented as explicit key state;
+* `checkpoint` — thin wrapper over `jax.checkpoint` (the TPU-idiomatic
+  rematerialization), with the reference's
+  `distribute_saved_activations` flag accepted (XLA + sharding
+  annotations already partition saved activations; see
+  `jax.checkpoint_policies.save_and_offload_only_these_names` for the
+  offload analogue).
+"""
+
+import contextlib
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.transformer import parallel_state
+
+__all__ = [
+    "RngStateTracker",
+    "get_rng_tracker",
+    "get_cuda_rng_tracker",
+    "model_parallel_seed",
+    "model_parallel_cuda_manual_seed",
+    "model_parallel_prng_keys",
+    "checkpoint",
+    "CheckpointPolicy",
+    "_MODEL_PARALLEL_RNG_TRACKER_NAME",
+]
+
+# Name of the model-parallel fork (reference random.py:110).
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+def model_parallel_prng_keys(seed: int, tp_rank) -> Dict[str, jax.Array]:
+    """Derive the default and model-parallel PRNG keys.
+
+    Seed arithmetic matches the reference (random.py:193-221):
+    ``offset = seed + 2718``, ``tensor_model_parallel_seed = offset +
+    tp_rank``, ``data_parallel_seed = seed``.
+    """
+    data_parallel_key = jax.random.PRNGKey(seed)
+    tensor_key = jax.random.fold_in(jax.random.PRNGKey(seed + 2718), tp_rank)
+    return {
+        "default": data_parallel_key,
+        _MODEL_PARALLEL_RNG_TRACKER_NAME: tensor_key,
+    }
+
+
+class RngStateTracker:
+    """Named PRNG key states with fork semantics.
+
+    Reference: CudaRNGStatesTracker (random.py:113-187). `fork(name)`
+    yields a fresh subkey from the named stream and advances the stream —
+    the functional analogue of "swap device RNG state in, run, swap out".
+    Host-level state: use outside jit (key material is then threaded into
+    jitted functions as arguments).
+    """
+
+    def __init__(self):
+        self._states: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self._states = {}
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self._states)
+
+    def set_states(self, states: Dict[str, jax.Array]):
+        self._states = dict(states)
+
+    def add(self, name: str, seed):
+        """Register a stream (reference random.py:141-159). `seed` may be
+        an int or a PRNGKey."""
+        if name in self._states:
+            raise RuntimeError(f"rng state {name} already exists")
+        key = seed if isinstance(seed, jax.Array) else jax.random.PRNGKey(seed)
+        self._states[name] = key
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield a subkey from the named stream and advance it
+        (reference random.py:161-187)."""
+        if name not in self._states:
+            raise RuntimeError(f"rng state {name} is not added")
+        key, sub = jax.random.split(self._states[name])
+        self._states[name] = key
+        yield sub
+
+
+_RNG_TRACKER = RngStateTracker()
+
+
+def get_rng_tracker() -> RngStateTracker:
+    """Reference: get_cuda_rng_tracker (random.py:188-190)."""
+    return _RNG_TRACKER
+
+
+# Reference-spelling alias so downstream Megatron-style code ports 1:1.
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_seed(seed: int, tp_rank: Optional[int] = None) -> None:
+    """Initialize the global tracker (reference:
+    model_parallel_cuda_manual_seed, random.py:193-221)."""
+    if tp_rank is None:
+        tp_rank = 0
+    keys = model_parallel_prng_keys(seed, tp_rank)
+    _RNG_TRACKER.reset()
+    for name, key in keys.items():
+        _RNG_TRACKER.add(name, key)
+
+
+model_parallel_cuda_manual_seed = model_parallel_seed
+
+
+class CheckpointPolicy:
+    """Named remat policies for the `checkpoint` wrapper."""
+
+    NOTHING_SAVEABLE = jax.checkpoint_policies.nothing_saveable
+    DOTS_SAVEABLE = jax.checkpoint_policies.dots_saveable
+    DOTS_WITH_NO_BATCH_DIMS = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+
+
+def checkpoint(
+    function: Callable,
+    *args,
+    distribute_saved_activations: bool = False,
+    policy=None,
+):
+    """Activation checkpointing: recompute `function` in the backward.
+
+    Reference: CheckpointFunction/checkpoint (random.py:224-293). The
+    reference saves RNG states and replays them during recompute; JAX
+    remat re-traces with the same functional keys, so randomness is
+    bit-identical with no bookkeeping. `distribute_saved_activations`
+    (reference random.py:248-255 partitions the saved input across TP
+    ranks) is subsumed by sharding annotations on the inputs; accepted
+    and ignored.
+    """
+    del distribute_saved_activations
+    return jax.checkpoint(function, policy=policy)(*args)

@@ -14,7 +14,7 @@ import os
 # accelerator (e.g. JAX_PLATFORMS=axon): distributed tests need 8 devices.
 # Escape hatch for running kernel tests on real hardware:
 #   APEX_TPU_TEST_PLATFORM=axon python -m pytest tests/L0/test_multi_tensor.py
-os.environ["JAX_PLATFORMS"] = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
+_platform = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +22,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# A sitecustomize hook in this image prepends the real-TPU "axon" platform
+# to jax_platforms, overriding the JAX_PLATFORMS env var — force the
+# simulated-mesh platform through the config API instead (must happen
+# before the backend initializes).
+jax.config.update("jax_platforms", _platform)
 
 import pytest  # noqa: E402
 
