@@ -1,0 +1,37 @@
+"""Data parallelism: gradient synchronization, SyncBatchNorm, LARC.
+
+TPU-native rebuild of the reference's `apex.parallel`
+(reference: apex/parallel/__init__.py, SURVEY.md §2.2). The reference
+ships an NCCL-optimized DistributedDataParallel with bucketed, stream-
+overlapped allreduce (apex/parallel/distributed.py:129-640); on TPU the
+mesh `data` axis plus `jax.lax.psum` plays that role, and bucketing /
+comm-compute overlap is done by XLA's latency-hiding scheduler rather
+than hand-managed CUDA streams. What remains user-visible — and is kept
+here — are the *policy* knobs (`allreduce_always_fp32`,
+`gradient_predivide_factor`, gradient averaging) and the module surface
+(`DistributedDataParallel`, `Reducer`, `SyncBatchNorm`,
+`convert_syncbn_model`, `LARC`).
+"""
+
+from rocm_apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    broadcast_params,
+    sync_gradients,
+)
+from rocm_apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+)
+from rocm_apex_tpu.parallel.larc import LARC, larc  # noqa: F401
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "broadcast_params",
+    "sync_gradients",
+    "SyncBatchNorm",
+    "convert_syncbn_model",
+    "LARC",
+    "larc",
+]

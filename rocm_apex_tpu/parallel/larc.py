@@ -1,0 +1,108 @@
+"""LARC: layer-wise adaptive rate control.
+
+TPU-native rebuild of the reference LARC wrapper
+(reference: apex/parallel/LARC.py:5-107). The reference wraps a torch
+optimizer and rewrites ``p.grad`` in-place before the inner ``step()``:
+per parameter, ``adaptive_lr = trust_coefficient·‖p‖ /
+(‖g‖ + wd·‖p‖ + eps)``; in ``clip`` mode the rate is capped at the
+group LR (``min(adaptive_lr/lr, 1)``), in scale mode applied directly;
+weight decay is folded into the gradient and zeroed on the inner
+optimizer (LARC.py:69-107).
+
+Here the same rewrite is an `optax.GradientTransformation` chained
+*before* the inner optimizer::
+
+    tx = optax.chain(larc(lr=0.1, trust_coefficient=1e-2), optax.sgd(0.1))
+
+or via the class wrapper matching the reference's surface::
+
+    opt = LARC(FusedSGD(lr=0.1), trust_coefficient=1e-2)
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["larc", "LARC"]
+
+
+def larc(
+    lr: float = 1.0,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Gradient rewrite matching reference LARC.step (LARC.py:69-107).
+
+    ``lr`` is only consulted in ``clip`` mode (the cap is relative to the
+    inner optimizer's LR, exactly as the reference reads ``group['lr']``).
+    Parameters with zero norm or zero gradient are passed through
+    unchanged (LARC.py:88).
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+
+        def one(g, p):
+            if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact):
+                return g
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            p_norm = jnp.linalg.norm(pf.ravel())
+            g_norm = jnp.linalg.norm(gf.ravel())
+            adaptive_lr = (
+                trust_coefficient * p_norm / (g_norm + p_norm * weight_decay + eps)
+            )
+            if clip:
+                adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+            new_g = (gf + weight_decay * pf) * adaptive_lr
+            # Zero-norm params/grads are left untouched (LARC.py:88).
+            ok = (p_norm != 0) & (g_norm != 0)
+            return jnp.where(ok, new_g, gf).astype(g.dtype)
+
+        return jax.tree_util.tree_map(one, updates, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class LARC:
+    """Class-style wrapper mirroring the reference's optimizer wrapper.
+
+    Wraps any object exposing optax's ``init(params)`` /
+    ``update(grads, state, params)`` pair (our FusedOptimizer classes
+    qualify) and applies the LARC gradient rewrite before delegating.
+    """
+
+    def __init__(
+        self,
+        optimizer,
+        trust_coefficient: float = 0.02,
+        clip: bool = True,
+        eps: float = 1e-8,
+        lr: Optional[float] = None,
+        weight_decay: float = 0.0,
+    ):
+        self.optimizer = optimizer
+        inferred_lr = lr if lr is not None else getattr(optimizer, "lr", 1.0)
+        self._tx = larc(
+            lr=float(inferred_lr) if not callable(inferred_lr) else 1.0,
+            trust_coefficient=trust_coefficient,
+            clip=clip,
+            eps=eps,
+            weight_decay=weight_decay,
+        )
+
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def update(self, grads, state, params=None):
+        grads, _ = self._tx.update(grads, optax.EmptyState(), params)
+        return self.optimizer.update(grads, state, params)

@@ -74,6 +74,17 @@ def _resolve_world_size(world_size: Optional[int]) -> int:
     return 1
 
 
+def _require_axis(axis_name: str, tp: int, cls: str) -> None:
+    """tp>1 outside shard_map would silently compute on 1/tp of the
+    weight (or die in a collective with a bare NameError) — fail fast
+    with a clear message instead."""
+    if _axis_rank(axis_name) is None:
+        raise ValueError(
+            f"{cls} with world_size={tp} must run inside shard_map with "
+            f"axis {axis_name!r} bound"
+        )
+
+
 class VocabParallelEmbedding(nn.Module):
     """Embedding sharded along the vocabulary dimension.
 
@@ -165,6 +176,8 @@ class ColumnParallelLinear(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         tp = _resolve_world_size(self.world_size)
+        if tp > 1:
+            _require_axis(self.axis_name, tp, "ColumnParallelLinear")
         out_per_partition = divide(self.output_size, tp)
         kernel = self.param(
             "kernel",
@@ -230,6 +243,8 @@ class RowParallelLinear(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         tp = _resolve_world_size(self.world_size)
+        if tp > 1:
+            _require_axis(self.axis_name, tp, "RowParallelLinear")
         in_per_partition = divide(self.input_size, tp)
         kernel = self.param(
             "kernel",

@@ -8,13 +8,13 @@ Mirrors the reference's multi-GPU TP tests on the 8-device CPU mesh:
 (reference: tests/L0/run_transformer/*)
 """
 
-import functools
+
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from rocm_apex_tpu.transformer import parallel_state, tensor_parallel
