@@ -1,0 +1,35 @@
+"""Reference model zoo: Megatron-style GPT and BERT, flax-native.
+
+Rebuild of the reference's testing models
+(reference: apex/transformer/testing/standalone_gpt.py (1504 LoC) and
+standalone_bert.py), which exist so the TP/PP machinery can be validated
+on a real transformer. Here they double as the framework's flagship
+models: TP via the shard_map tensor-parallel layers, PP via uniform
+`ParallelTransformerLayer` stacks fed to the pipeline schedules, DP via
+the mesh data axis.
+"""
+
+from rocm_apex_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    ParallelAttention,
+    ParallelMLP,
+    ParallelTransformer,
+    ParallelTransformerLayer,
+    TransformerEmbedding,
+    gpt_loss_fn,
+)
+from rocm_apex_tpu.models.bert import BertConfig, BertModel  # noqa: F401
+
+__all__ = [
+    "GPTConfig",
+    "GPTModel",
+    "ParallelMLP",
+    "ParallelAttention",
+    "ParallelTransformerLayer",
+    "ParallelTransformer",
+    "TransformerEmbedding",
+    "gpt_loss_fn",
+    "BertConfig",
+    "BertModel",
+]

@@ -1,0 +1,395 @@
+"""Megatron-style GPT, TPU-native.
+
+Rebuild of the reference's standalone GPT
+(reference: apex/transformer/testing/standalone_gpt.py — ParallelMLP:234,
+ParallelAttention:283, ParallelTransformerLayer:575,
+ParallelTransformer:711, Embedding:998, TransformerLanguageModel:1147)
+as flax modules over the shard_map tensor-parallel layers. Departures by
+design:
+
+* activations are ``[batch, seq, hidden]`` (TPU-friendly; Megatron uses
+  ``[seq, batch, hidden]`` for NCCL-contiguity reasons that do not apply);
+* core attention uses the Pallas scaled causal/masked softmax with no
+  2048-seqlen ceiling (reference fused_softmax.py:160) and bf16 compute;
+* layers are uniform blocks so a stack maps 1:1 onto the pipeline
+  schedules' stacked-params convention (schedules.py), and onto
+  `lax.scan` for compile-time-friendly deep stacks;
+* dropout uses flax functional RNG — per-TP-rank independence comes from
+  folding the tp rank into the key, the analogue of the reference's
+  CudaRNGStatesTracker (tensor_parallel/random.py:113-193).
+
+The TP degree is taken from ``config.tensor_parallel_size``; with 1 the
+modules run unsharded (GSPMD/pjit users annotate instead).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocm_apex_tpu.normalization import MixedFusedLayerNorm
+from rocm_apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from rocm_apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+
+__all__ = [
+    "GPTConfig",
+    "GPTModel",
+    "ParallelMLP",
+    "ParallelAttention",
+    "ParallelTransformerLayer",
+    "ParallelTransformer",
+    "TransformerEmbedding",
+    "gpt_loss_fn",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Model hyperparameters; the static subset of the reference's
+    Megatron argument system (apex/transformer/testing/arguments.py)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 1024
+    num_layers: int = 12
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 2048
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layernorm_epsilon: float = 1e-5
+    apply_residual_connection_post_layernorm: bool = False
+    # fp32 params + bf16 compute = the O5/bf16-master recipe.
+    params_dtype: Any = jnp.float32
+    dtype: Any = jnp.bfloat16
+    tensor_parallel_size: Optional[int] = None  # None -> parallel_state
+    tensor_axis: str = parallel_state.TENSOR_AXIS
+    init_method_std: float = 0.02
+    use_pallas_softmax: bool = True
+
+    @property
+    def ffn_size(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_attention_heads == 0
+        return self.hidden_size // self.num_attention_heads
+
+
+def _init(cfg: GPTConfig):
+    return nn.initializers.normal(stddev=cfg.init_method_std)
+
+
+def _scaled_init(cfg: GPTConfig):
+    """Output-layer init scaled by 1/sqrt(2*num_layers), Megatron's
+    scheme for residual-path projections (standalone_gpt.py uses
+    scaled_init_method_normal)."""
+    return nn.initializers.normal(
+        stddev=cfg.init_method_std / np.sqrt(2.0 * cfg.num_layers)
+    )
+
+
+class ParallelMLP(nn.Module):
+    """h → 4h (column-parallel) → gelu → 4h → h (row-parallel)
+    (reference: standalone_gpt.py:234-281)."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        h, _ = ColumnParallelLinear(
+            cfg.hidden_size,
+            cfg.ffn_size,
+            gather_output=False,
+            init_method=_init(cfg),
+            params_dtype=cfg.params_dtype,
+            dtype=cfg.dtype,
+            world_size=cfg.tensor_parallel_size,
+            axis_name=cfg.tensor_axis,
+            name="dense_h_to_4h",
+        )(x)
+        h = nn.gelu(h)
+        y, _ = RowParallelLinear(
+            cfg.ffn_size,
+            cfg.hidden_size,
+            input_is_parallel=True,
+            init_method=_scaled_init(cfg),
+            params_dtype=cfg.params_dtype,
+            dtype=cfg.dtype,
+            world_size=cfg.tensor_parallel_size,
+            axis_name=cfg.tensor_axis,
+            name="dense_4h_to_h",
+        )(h)
+        return y
+
+
+class ParallelAttention(nn.Module):
+    """Self-attention with TP-sharded heads
+    (reference: standalone_gpt.py:283-574): column-parallel fused QKV,
+    scaled-masked-softmax core, row-parallel output projection.
+
+    ``attn_mask_type``: 'causal' uses the Pallas upper-triang softmax;
+    'padding' takes an explicit mask (True = masked).
+    """
+
+    cfg: GPTConfig
+    attn_mask_type: str = "causal"
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        cfg = self.cfg
+        tp = cfg.tensor_parallel_size or (
+            parallel_state.get_tensor_model_parallel_world_size()
+            if parallel_state.model_parallel_is_initialized()
+            else 1
+        )
+        nh_local = cfg.num_attention_heads // tp
+        hd = cfg.head_dim
+        b, sq, _ = x.shape
+
+        qkv, _ = ColumnParallelLinear(
+            cfg.hidden_size,
+            3 * cfg.hidden_size,
+            gather_output=False,
+            init_method=_init(cfg),
+            params_dtype=cfg.params_dtype,
+            dtype=cfg.dtype,
+            world_size=cfg.tensor_parallel_size,
+            axis_name=cfg.tensor_axis,
+            name="query_key_value",
+        )(x)
+        qkv = qkv.reshape(b, sq, nh_local, 3 * hd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
+
+        scale = 1.0 / np.sqrt(hd)
+        scores = jnp.einsum(
+            "bqnd,bknd->bnqk", q, k, preferred_element_type=jnp.float32
+        )
+        if self.attn_mask_type == "causal":
+            if cfg.use_pallas_softmax:
+                probs = scaled_upper_triang_masked_softmax(
+                    scores.reshape(b * nh_local, sq, sq), scale
+                ).reshape(b, nh_local, sq, sq)
+            else:
+                mask = ~jnp.tril(jnp.ones((sq, sq), bool))
+                s = jnp.where(mask, -jnp.inf, scores * scale)
+                probs = jax.nn.softmax(s, axis=-1)
+        else:
+            if attention_mask is None:
+                raise ValueError("padding attention needs attention_mask")
+            mask = jnp.broadcast_to(
+                attention_mask, (b, 1, sq, scores.shape[-1])
+            )
+            if cfg.use_pallas_softmax:
+                probs = scaled_masked_softmax(scores, mask, scale)
+            else:
+                s = jnp.where(mask, -jnp.inf, scores * scale)
+                probs = jax.nn.softmax(s, axis=-1)
+        probs = probs.astype(cfg.dtype)
+
+        if cfg.attention_dropout > 0.0:
+            # The reference forks the model-parallel RNG for attention
+            # dropout (get_cuda_rng_tracker().fork(), standalone_gpt.py);
+            # flax's named RNG + TP-rank folding is the equivalent.
+            probs = nn.Dropout(rate=cfg.attention_dropout)(
+                probs, deterministic=deterministic
+            )
+
+        ctx = jnp.einsum(
+            "bnqk,bknd->bqnd", probs, v, preferred_element_type=cfg.dtype
+        )
+        ctx = ctx.reshape(b, sq, nh_local * hd)
+        y, _ = RowParallelLinear(
+            cfg.hidden_size,
+            cfg.hidden_size,
+            input_is_parallel=True,
+            init_method=_scaled_init(cfg),
+            params_dtype=cfg.params_dtype,
+            dtype=cfg.dtype,
+            world_size=cfg.tensor_parallel_size,
+            axis_name=cfg.tensor_axis,
+            name="dense",
+        )(ctx)
+        return y
+
+
+class ParallelTransformerLayer(nn.Module):
+    """Pre-LN transformer block (reference: standalone_gpt.py:575-710):
+    LN → attention → residual, LN → MLP → residual, with the
+    `apply_residual_connection_post_layernorm` variant."""
+
+    cfg: GPTConfig
+    attn_mask_type: str = "causal"
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        cfg = self.cfg
+        ln1 = MixedFusedLayerNorm(
+            cfg.hidden_size, eps=cfg.layernorm_epsilon, name="input_layernorm"
+        )(x)
+        attn = ParallelAttention(cfg, self.attn_mask_type, name="self_attention")(
+            ln1, attention_mask, deterministic
+        )
+        if cfg.hidden_dropout > 0.0:
+            attn = nn.Dropout(rate=cfg.hidden_dropout)(
+                attn, deterministic=deterministic
+            )
+        residual = ln1 if cfg.apply_residual_connection_post_layernorm else x
+        x = residual + attn.astype(residual.dtype)
+
+        ln2 = MixedFusedLayerNorm(
+            cfg.hidden_size,
+            eps=cfg.layernorm_epsilon,
+            name="post_attention_layernorm",
+        )(x)
+        mlp = ParallelMLP(cfg, name="mlp")(ln2, deterministic)
+        if cfg.hidden_dropout > 0.0:
+            mlp = nn.Dropout(rate=cfg.hidden_dropout)(
+                mlp, deterministic=deterministic
+            )
+        residual = ln2 if cfg.apply_residual_connection_post_layernorm else x
+        return (residual + mlp.astype(residual.dtype)).astype(cfg.dtype)
+
+
+class ParallelTransformer(nn.Module):
+    """A stack of identical layers (reference: standalone_gpt.py:711-996),
+    ended by a final LayerNorm. ``num_layers`` defaults to the config's;
+    pipeline users build one stack per stage with
+    ``num_layers = cfg.num_layers // pp`` (parallel_state.get_num_layers).
+    """
+
+    cfg: GPTConfig
+    num_layers: Optional[int] = None
+    attn_mask_type: str = "causal"
+    post_layer_norm: bool = True
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        n = self.num_layers or self.cfg.num_layers
+        for i in range(n):
+            x = ParallelTransformerLayer(
+                self.cfg, self.attn_mask_type, name=f"layer_{i}"
+            )(x, attention_mask, deterministic)
+        if self.post_layer_norm:
+            x = MixedFusedLayerNorm(
+                self.cfg.hidden_size,
+                eps=self.cfg.layernorm_epsilon,
+                name="final_layernorm",
+            )(x)
+        return x.astype(self.cfg.dtype)
+
+
+class TransformerEmbedding(nn.Module):
+    """Word (vocab-parallel) + learned position embeddings + dropout
+    (reference: standalone_gpt.py:998-1146). ``attend`` projects hidden
+    states back onto the vocabulary with the tied word-embedding table.
+    """
+
+    cfg: GPTConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            init_method=_init(cfg),
+            params_dtype=cfg.params_dtype,
+            dtype=cfg.dtype,
+            world_size=cfg.tensor_parallel_size,
+            axis_name=cfg.tensor_axis,
+            name="word_embeddings",
+        )
+        self.position_embeddings = self.param(
+            "position_embeddings",
+            _init(cfg),
+            (cfg.max_position_embeddings, cfg.hidden_size),
+            cfg.params_dtype,
+        )
+        self.dropout = nn.Dropout(rate=cfg.hidden_dropout)
+
+    def __call__(self, tokens, position_ids=None, deterministic: bool = True):
+        cfg = self.cfg
+        words = self.word_embeddings(tokens)
+        if position_ids is None:
+            position_ids = jnp.arange(tokens.shape[1])[None, :]
+        pos = jnp.take(self.position_embeddings, position_ids, axis=0).astype(
+            cfg.dtype
+        )
+        x = words + pos
+        if cfg.hidden_dropout > 0.0:
+            x = self.dropout(x, deterministic=deterministic)
+        return x
+
+    def attend(self, hidden):
+        return self.word_embeddings.attend(hidden)
+
+
+class GPTModel(nn.Module):
+    """Embedding → transformer → tied vocab-parallel LM head
+    (reference: standalone_gpt.py:1147-1504 TransformerLanguageModel +
+    post_language_model_processing).
+
+    Returns vocab-parallel logits ``(b, s, vocab/tp)``; pair with
+    `vocab_parallel_cross_entropy` (or `gpt_loss_fn`). With
+    ``labels is not None`` returns per-token losses instead, matching the
+    reference's GPT forward.
+    """
+
+    cfg: GPTConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embedding = TransformerEmbedding(cfg, name="embedding")
+        self.transformer = ParallelTransformer(cfg, name="transformer")
+
+    def __call__(
+        self,
+        tokens,
+        position_ids=None,
+        labels=None,
+        loss_mask=None,
+        deterministic: bool = True,
+    ):
+        x = self.embedding(tokens, position_ids, deterministic)
+        x = self.transformer(x, deterministic=deterministic)
+        # Tied head: project with the word-embedding table.
+        logits = self.embedding.attend(x)
+        if labels is None:
+            return logits
+        tp = self.cfg.tensor_parallel_size or 1
+        if tp > 1 or parallel_state.model_parallel_is_initialized():
+            losses = vocab_parallel_cross_entropy(
+                logits.astype(jnp.float32), labels, self.cfg.tensor_axis
+            )
+        else:
+            losses = _serial_cross_entropy(logits.astype(jnp.float32), labels)
+        if loss_mask is not None:
+            losses = losses * loss_mask
+        return losses
+
+
+def _serial_cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+def gpt_loss_fn(losses, loss_mask=None):
+    """Mean per-token loss (reference loss_func in the GPT tests)."""
+    if loss_mask is not None:
+        return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
+    return jnp.mean(losses)

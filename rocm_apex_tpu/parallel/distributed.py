@@ -146,7 +146,9 @@ def broadcast_params(params: Any, axis_name: Optional[str] = None) -> Any:
     def one(p):
         if not _is_float(p):
             return p
-        return jax.lax.pmean(p.astype(jnp.float32), axis).astype(p.dtype)
+        # Accumulate in >= fp32 but never truncate wider dtypes.
+        acc = p.dtype if jnp.finfo(p.dtype).bits >= 32 else jnp.float32
+        return jax.lax.pmean(p.astype(acc), axis).astype(p.dtype)
 
     return jax.tree_util.tree_map(one, params)
 

@@ -91,9 +91,19 @@ class LARC:
         weight_decay: float = 0.0,
     ):
         self.optimizer = optimizer
-        inferred_lr = lr if lr is not None else getattr(optimizer, "lr", 1.0)
+        inferred_lr = lr if lr is not None else getattr(optimizer, "lr", None)
+        if clip and (inferred_lr is None or callable(inferred_lr)):
+            # Clip mode caps the adaptive rate at the inner LR (reference
+            # LARC.py:97 reads group['lr']); guessing would silently
+            # mis-scale gradients.
+            raise ValueError(
+                "LARC in clip mode needs the inner optimizer's learning "
+                "rate: pass lr= explicitly (schedules are not supported "
+                "by the class wrapper; chain the larc() transformation "
+                "instead)"
+            )
         self._tx = larc(
-            lr=float(inferred_lr) if not callable(inferred_lr) else 1.0,
+            lr=float(inferred_lr) if inferred_lr is not None else 1.0,
             trust_coefficient=trust_coefficient,
             clip=clip,
             eps=eps,

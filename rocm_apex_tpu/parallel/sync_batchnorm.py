@@ -71,7 +71,8 @@ class SyncBatchNorm(nn.Module):
     axis_index_groups: Optional[Sequence[Sequence[int]]] = None
     channel_last: bool = False
     fuse_relu: bool = False
-    dtype: jnp.dtype = jnp.float32
+    # None = compute/output dtype follows the input (flax convention).
+    dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
     use_running_average: Optional[bool] = None
 
@@ -82,6 +83,11 @@ class SyncBatchNorm(nn.Module):
         use_running_average = nn.merge_param(
             "use_running_average", self.use_running_average, use_running_average
         )
+        # torch semantics: with track_running_stats=False there are no
+        # running buffers and eval uses batch statistics too.
+        if not self.track_running_stats:
+            use_running_average = False
+        out_dtype = self.dtype if self.dtype is not None else x.dtype
         ch_axis = x.ndim - 1 if self.channel_last else min(1, x.ndim - 1)
         c = x.shape[ch_axis]
         if self.num_features is not None and self.num_features != c:
@@ -150,13 +156,13 @@ class SyncBatchNorm(nn.Module):
                     ra_var.value = (1 - m) * ra_var.value + m * jax.lax.stop_gradient(unbiased)
 
         shape = tuple(c if i == ch_axis else 1 for i in range(x.ndim))
-        y = (x.astype(self.dtype) - mean.reshape(shape).astype(self.dtype)) * (
-            jax.lax.rsqrt(var + self.eps).reshape(shape).astype(self.dtype)
+        y = (x.astype(out_dtype) - mean.reshape(shape).astype(out_dtype)) * (
+            jax.lax.rsqrt(var + self.eps).reshape(shape).astype(out_dtype)
         )
         if scale is not None:
-            y = y * scale.reshape(shape).astype(self.dtype)
+            y = y * scale.reshape(shape).astype(out_dtype)
         if bias is not None:
-            y = y + bias.reshape(shape).astype(self.dtype)
+            y = y + bias.reshape(shape).astype(out_dtype)
         if self.fuse_relu:
             y = nn.relu(y)
         return y
@@ -198,14 +204,20 @@ def convert_syncbn_model(
                     )
             else:
                 cl = channel_last
+            if obj.use_scale != obj.use_bias:
+                raise ValueError(
+                    "convert_syncbn_model: BatchNorm with use_scale != "
+                    "use_bias has no SyncBatchNorm equivalent (affine is "
+                    "all-or-nothing, as in torch)"
+                )
             return SyncBatchNorm(
                 eps=obj.epsilon,
                 momentum=1.0 - obj.momentum,
-                affine=obj.use_scale and obj.use_bias,
+                affine=obj.use_scale,
                 axis_name=axis_name,
                 axis_index_groups=axis_index_groups,
                 channel_last=cl,
-                dtype=obj.dtype or jnp.float32,
+                dtype=obj.dtype,
                 param_dtype=obj.param_dtype,
                 use_running_average=obj.use_running_average,
             )

@@ -1,0 +1,8 @@
+"""Model-parallel-aware loss scaling."""
+
+from rocm_apex_tpu.transformer.amp.grad_scaler import (  # noqa: F401
+    GradScaler,
+    sync_found_inf,
+)
+
+__all__ = ["GradScaler", "sync_found_inf"]

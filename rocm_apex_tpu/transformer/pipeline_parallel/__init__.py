@@ -1,0 +1,41 @@
+"""Pipeline parallelism: schedules, p2p transfer, microbatch calculus.
+
+TPU-native rebuild of the reference's pipeline layer
+(reference: apex/transformer/pipeline_parallel/, SURVEY.md §2.5). The
+reference drives per-rank asymmetric 1F1B schedules with batched NCCL
+isend/irecv between neighbouring pipeline processes; on TPU the whole
+pipeline is ONE SPMD program: stage transfer is `lax.ppermute` over the
+``pipe`` mesh axis, the microbatch loop is `lax.scan`, and the backward
+pipeline (the reference's cooldown phase of hand-ordered backward_steps)
+falls out of autodiff — the transpose of a ppermute-scan *is* the
+reverse pipeline. Memory behaviour equivalent to 1F1B comes from
+`jax.checkpoint` on the stage body rather than from interleaving
+forward/backward by hand; XLA's scheduler overlaps the permute traffic
+with stage compute.
+"""
+
+from rocm_apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+from rocm_apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
+from rocm_apex_tpu.transformer.pipeline_parallel.microbatches import (  # noqa: F401
+    ConstantNumMicroBatches,
+    NumMicroBatchesCalculator,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "p2p_communication",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+    "NumMicroBatchesCalculator",
+    "build_num_microbatches_calculator",
+]

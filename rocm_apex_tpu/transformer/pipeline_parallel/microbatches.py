@@ -1,0 +1,160 @@
+"""Number-of-microbatches calculators (constant + batch-size rampup).
+
+Same semantics as the reference calculators
+(reference: apex/transformer/microbatches.py:21-172): the number of
+microbatches per step is ``global_batch // (micro_batch * dp)``, and the
+rampup variant grows the global batch linearly from ``start_batch_size``
+to ``global_batch_size`` in ``batch_size_increment`` steps spread evenly
+over ``rampup_samples`` consumed samples. Pure host-side Python — the
+resulting count is a *static* trip count for the jitted pipeline (a
+changed count triggers a recompile, which is the XLA-correct way to
+express a ramp: a handful of compilations, each with static shapes).
+"""
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from rocm_apex_tpu import logger
+
+__all__ = [
+    "build_num_microbatches_calculator",
+    "NumMicroBatchesCalculator",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+]
+
+
+def build_num_microbatches_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> "NumMicroBatchesCalculator":
+    """Factory (reference: microbatches.py:21-66)."""
+    if rampup_batch_size is None:
+        calc = ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size
+        )
+        if rank == 0:
+            logger.info(
+                "setting number of micro-batches to constant %d", calc.get()
+            )
+        return calc
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "expected rampup_batch_size = [start, increment, rampup_samples], "
+            f"got {rampup_batch_size!r}"
+        )
+    start, inc, samples = (int(v) for v in rampup_batch_size)
+    if rank == 0:
+        logger.info(
+            "batch size rampup: %d -> %d in increments of %d over %d samples",
+            start,
+            global_batch_size,
+            inc,
+            samples,
+        )
+    return RampupBatchsizeNumMicroBatches(
+        start, inc, samples, global_batch_size, micro_batch_size, data_parallel_size
+    )
+
+
+class NumMicroBatchesCalculator(ABC):
+    def __init__(self):
+        self.num_micro_batches: Optional[int] = None
+        self.current_global_batch_size: Optional[int] = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    @abstractmethod
+    def update(self, consumed_samples: int, consistency_check: bool) -> None:
+        ...
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """reference: microbatches.py:84-99."""
+
+    def __init__(self, global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        per_step = micro_batch_size * data_parallel_size
+        if global_batch_size % per_step != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) times data parallel "
+                f"size ({data_parallel_size})"
+            )
+        self.num_micro_batches = global_batch_size // per_step
+        if self.num_micro_batches < 1:
+            raise ValueError("need at least one microbatch")
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        pass
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Linear global-batch ramp (reference: microbatches.py:101-172)."""
+
+    def __init__(
+        self,
+        start_batch_size: int,
+        batch_size_increment: int,
+        rampup_samples: int,
+        global_batch_size: int,
+        micro_batch_size: int,
+        data_parallel_size: int,
+    ):
+        super().__init__()
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size
+        )
+        if start_batch_size <= 0 or batch_size_increment <= 0:
+            raise ValueError("start_batch_size and increment must be positive")
+        self.start_batch_size = start_batch_size
+        self.global_batch_size = global_batch_size
+        diff = global_batch_size - start_batch_size
+        if diff < 0 or diff % batch_size_increment != 0:
+            raise ValueError(
+                f"global batch size interval ({diff}) must be a non-negative "
+                f"multiple of the increment ({batch_size_increment})"
+            )
+        self.batch_size_increment = batch_size_increment
+        self.rampup_samples = rampup_samples
+        if rampup_samples < 0:
+            raise ValueError("rampup_samples must be >= 0")
+        num_increments = max(diff // batch_size_increment, 1)
+        self.rampup_samples_per_increment = rampup_samples / num_increments
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool) -> None:
+        if consumed_samples > self.rampup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment
+            )
+            assert self.current_global_batch_size <= self.global_batch_size
+        if consistency_check and (
+            self.current_global_batch_size
+            % self.micro_batch_times_data_parallel_size
+            != 0
+        ):
+            raise ValueError(
+                f"current global batch size ({self.current_global_batch_size}) "
+                f"is not divisible by micro-batch-size "
+                f"({self.micro_batch_size}) times data parallel size "
+                f"({self.data_parallel_size})"
+            )
+        self.num_micro_batches = (
+            self.current_global_batch_size
+            // self.micro_batch_times_data_parallel_size
+        )

@@ -1,0 +1,298 @@
+"""The three pipeline schedules, as SPMD scan-over-ppermute programs.
+
+TPU-native redesign of the reference's schedule trio
+(reference: apex/transformer/pipeline_parallel/schedules/ — dispatcher
+`__init__.py:16-34`, `fwd_bwd_no_pipelining.py:29`, 1F1B
+`fwd_bwd_pipelining_without_interleaving.py:22-170`, interleaved
+`fwd_bwd_pipelining_with_interleaving.py:41-308`). The reference runs a
+*per-rank asymmetric* program: warmup = P−rank−1 forwards, a steady
+1F1B phase of paired send_forward_recv_backward, and a cooldown of
+backwards, all over NCCL P2P. Single-controller JAX cannot (and should
+not) express per-rank control flow; instead each schedule here is one
+SPMD program in which every stage runs the same `lax.scan` and
+activations hop stages via `lax.ppermute`:
+
+* tick ``t``: stage ``s`` computes microbatch ``t−s`` (when valid) and
+  the permute hands its output to ``s+1`` — exactly the reference's
+  pipeline diagram, with warmup/steady/cooldown appearing as the
+  triangular valid-regions of the scan rather than as python phases;
+* the *backward* pipeline is not written at all: differentiating the
+  scan transposes every ppermute (reverse direction) and replays the
+  ticks in reverse order, which IS the cooldown phase;
+* 1F1B's raison d'être — bounding live activations to P microbatches
+  instead of M — is delivered by `jax.checkpoint` on the stage body
+  (`checkpoint_stages=True`): residuals per tick shrink to the carried
+  activation, and XLA rematerializes during the transposed scan;
+* the interleaved schedule becomes a *circular* pipeline: each stage
+  holds ``vp`` model chunks, the permute wraps P−1 → 0, and crossing
+  the wrap advances the chunk index — same unit ordering as the
+  reference's `num_warmup` doubling / chunk-id scheduling, derived from
+  the closed-form tick formula instead of bookkeeping.
+
+All schedule functions share one signature (the reference's share theirs
+via `forward_step_func`):
+
+    schedule(stage_fn, loss_fn, params, inputs, targets, ...)
+      stage_fn(stage_params, x) -> y        uniform stage body (x, y same
+                                            shape — the reference has the
+                                            same constraint, tensor_shape)
+      loss_fn(y_last, target) -> scalar     applied on the final stage
+      params:  leaves stacked over stages — local shard inside shard_map
+               has leading dim 1 (non-interleaved) or vp (interleaved);
+               no leading axis for no-pipelining
+      inputs:  (M, micro_batch, ...) microbatched inputs, replicated
+               across the pipe axis
+      targets: (M, ...) per-microbatch targets
+
+    returns (per_microbatch_losses, grads) — grads of mean loss w.r.t.
+    params (None when forward_only), loss replicated on every stage.
+
+Pipelined schedules must run inside shard_map with the ``pipe`` axis
+bound; `forward_backward_no_pipelining` runs anywhere.
+"""
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.transformer import parallel_state
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+]
+
+StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
+LossFn = Callable[[jnp.ndarray, Any], jnp.ndarray]
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: Optional[int] = None,
+):
+    """Pick the schedule (reference: schedules/__init__.py:16-34)."""
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = (
+            parallel_state.get_pipeline_model_parallel_world_size()
+        )
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+def _maybe_checkpoint(fn: StageFn, on: bool) -> StageFn:
+    return jax.checkpoint(fn) if on else fn
+
+
+def forward_backward_no_pipelining(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    params: Any,
+    inputs: jnp.ndarray,
+    targets: Any,
+    *,
+    forward_only: bool = False,
+    checkpoint_stages: bool = False,
+    axis_name: Optional[str] = None,
+    **unused_kw,
+):
+    """Sequential microbatch loop with gradient accumulation.
+
+    reference: fwd_bwd_no_pipelining.py:29-84 — grads accumulate across
+    the microbatch loop and sync once (the reference suppresses DDP
+    hooks until the last microbatch; here accumulation is explicit and
+    the caller psums afterwards). Loss is divided by the number of
+    microbatches, as the reference does inside forward_step
+    (schedules/common.py:158-166).
+    """
+    del axis_name
+    m = inputs.shape[0]
+    body = _maybe_checkpoint(stage_fn, checkpoint_stages)
+
+    def one_loss(p, x, t):
+        return loss_fn(body(p, x), t)
+
+    if forward_only:
+        losses = jax.lax.map(lambda xt: one_loss(params, xt[0], xt[1]), (inputs, targets))
+        return losses, None
+
+    def step(acc, xt):
+        x, t = xt
+        loss, g = jax.value_and_grad(one_loss)(params, x, t)
+        acc = jax.tree_util.tree_map(lambda a, b: a + b / m, acc, g)
+        return acc, loss
+
+    zero = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+    grads, losses = jax.lax.scan(step, zero, (inputs, targets))
+    return losses, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    params: Any,
+    inputs: jnp.ndarray,
+    targets: Any,
+    *,
+    forward_only: bool = False,
+    checkpoint_stages: bool = True,
+    axis_name: Optional[str] = None,
+    **unused_kw,
+):
+    """The 1F1B-equivalent linear pipeline.
+
+    reference: fwd_bwd_pipelining_without_interleaving.py:22-170. Tick
+    ``t`` has stage ``s`` working on microbatch ``t−s``; with M
+    microbatches the scan runs M+P−1 ticks. The reference's warmup
+    (P−rank−1 forwards), steady 1F1B and cooldown are the lower/upper
+    triangles of the (tick, stage) diagram and need no code; backward
+    order comes from the scan transpose.
+    """
+    axis = axis_name or parallel_state.PIPE_AXIS
+    p = jax.lax.axis_size(axis)
+    m = inputs.shape[0]
+    ticks = m + p - 1
+    rank = jax.lax.axis_index(axis)
+    is_first = rank == 0
+    is_last = rank == p - 1
+    body = _maybe_checkpoint(stage_fn, checkpoint_stages)
+    perm = [(i, i + 1) for i in range(p - 1)]
+
+    local_params = jax.tree_util.tree_map(
+        lambda x: jnp.squeeze(x, 0) if x.shape[:1] == (1,) else x, params
+    )
+
+    def run(local_params):
+        def tick(carry, t):
+            act_recv, loss_buf = carry
+            mb_in = jnp.clip(t, 0, m - 1)
+            x = jnp.where(is_first, inputs[mb_in], act_recv)
+            y = body(local_params, x)
+            # Output collection on the last stage: tick t completes
+            # microbatch t-(P-1).
+            mb_out = t - (p - 1)
+            valid = (mb_out >= 0) & is_last
+            mb_out_c = jnp.clip(mb_out, 0, m - 1)
+            mb_loss = loss_fn(y, jax.tree_util.tree_map(lambda v: v[mb_out_c], targets))
+            loss_buf = loss_buf.at[mb_out_c].set(
+                jnp.where(valid, mb_loss.astype(jnp.float32), loss_buf[mb_out_c])
+            )
+            sent = jax.lax.ppermute(y, axis, perm)
+            return (sent, loss_buf), None
+
+        act0 = jax.lax.pcast(jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying')
+        loss0 = jax.lax.pcast(jnp.zeros((m,), jnp.float32), (axis,), to='varying')
+        (_, loss_buf), _ = jax.lax.scan(tick, (act0, loss0), jnp.arange(ticks))
+        # Replicate the last stage's losses to every stage so the caller
+        # sees one logical value (reference keeps losses on the last
+        # stage only and broadcasts out-of-band).
+        loss_buf = jax.lax.psum(jnp.where(is_last, loss_buf, 0.0), axis)
+        return jnp.mean(loss_buf), loss_buf
+
+    if forward_only:
+        _, losses = run(local_params)
+        return losses, None
+    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(local_params)
+    grads = jax.tree_util.tree_map(
+        lambda g, x: g[None] if x.shape[:1] == (1,) else g, grads, params
+    )
+    return losses, grads
+
+
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    params: Any,
+    inputs: jnp.ndarray,
+    targets: Any,
+    *,
+    forward_only: bool = False,
+    checkpoint_stages: bool = True,
+    axis_name: Optional[str] = None,
+    **unused_kw,
+):
+    """Interleaved virtual stages as a circular pipeline.
+
+    reference: fwd_bwd_pipelining_with_interleaving.py:41-308. Each stage
+    holds ``vp`` model chunks (params leaves: (vp, ...) locally); global
+    stage ``g = v·P + s``. Work unit (microbatch m, chunk v) runs on
+    stage s at tick
+
+        t(m, v, s) = (m // P)·P·vp + v·P + (m % P) + s
+
+    which is exactly the reference's round-robin chunk order (rounds of
+    P microbatches sweep all chunks before the next round). Consecutive
+    global stages differ by one tick, so a single wrap-around ring
+    permute carries every transfer, including the chunk hand-off
+    P−1 → 0. Requires M % P == 0, like the reference
+    (fwd_bwd_pipelining_with_interleaving.py asserts the same).
+    """
+    axis = axis_name or parallel_state.PIPE_AXIS
+    p = jax.lax.axis_size(axis)
+    m = inputs.shape[0]
+    if m % p != 0:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches ({m}) divisible "
+            f"by pipeline size ({p})"
+        )
+    vp_sizes = {
+        leaf.shape[0] for leaf in jax.tree_util.tree_leaves(params)
+    }
+    if len(vp_sizes) != 1:
+        raise ValueError(
+            f"all param leaves must share the leading (vp) axis; got sizes "
+            f"{sorted(vp_sizes)}"
+        )
+    vp = vp_sizes.pop()
+    ticks = m * vp + p - 1
+    rank = jax.lax.axis_index(axis)
+    body = _maybe_checkpoint(stage_fn, checkpoint_stages)
+    ring = [(i, (i + 1) % p) for i in range(p)]
+    round_len = p * vp
+
+    def run(params):
+        def tick(carry, t):
+            act_recv, loss_buf = carry
+            r = t - rank
+            rnd, rr = r // round_len, r % round_len
+            v = rr // p
+            mb = rnd * p + rr % p
+            valid = (r >= 0) & (mb >= 0) & (mb < m)
+            v_c = jnp.clip(v, 0, vp - 1)
+            mb_c = jnp.clip(mb, 0, m - 1)
+            chunk = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, v_c, 0, keepdims=False),
+                params,
+            )
+            is_entry = (rank == 0) & (v_c == 0)
+            x = jnp.where(is_entry, inputs[mb_c], act_recv)
+            y = body(chunk, x)
+            is_exit = (rank == p - 1) & (v_c == vp - 1) & valid
+            mb_loss = loss_fn(y, jax.tree_util.tree_map(lambda q: q[mb_c], targets))
+            loss_buf = loss_buf.at[mb_c].set(
+                jnp.where(is_exit, mb_loss.astype(jnp.float32), loss_buf[mb_c])
+            )
+            sent = jax.lax.ppermute(y, axis, ring)
+            return (sent, loss_buf), None
+
+        act0 = jax.lax.pcast(jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying')
+        loss0 = jax.lax.pcast(jnp.zeros((m,), jnp.float32), (axis,), to='varying')
+        (_, loss_buf), _ = jax.lax.scan(tick, (act0, loss0), jnp.arange(ticks))
+        loss_buf = jax.lax.psum(
+            jnp.where(rank == p - 1, loss_buf, 0.0), axis
+        )
+        return jnp.mean(loss_buf), loss_buf
+
+    if forward_only:
+        _, losses = run(params)
+        return losses, None
+    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(params)
+    return losses, grads

@@ -110,25 +110,24 @@ class VocabParallelEmbedding(nn.Module):
     world_size: Optional[int] = None
     axis_name: str = parallel_state.TENSOR_AXIS
 
-    @nn.compact
-    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+    def setup(self):
         tp = _resolve_world_size(self.world_size)
         per_partition = divide(self.num_embeddings, tp)
-        weight = self.param(
+        self.weight = self.param(
             "weight",
             _sharded_init(self.init_method, self.axis_name),
             (per_partition, self.embedding_dim),
             self.params_dtype,
         )
-        if tp == 1:
-            return jnp.take(weight, ids, axis=0).astype(self.dtype)
 
+    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+        tp = _resolve_world_size(self.world_size)
+        per_partition = divide(self.num_embeddings, tp)
+        if tp == 1:
+            return jnp.take(self.weight, ids, axis=0).astype(self.dtype)
+
+        _require_axis(self.axis_name, tp, "VocabParallelEmbedding")
         rank = _axis_rank(self.axis_name)
-        if rank is None:
-            raise ValueError(
-                f"VocabParallelEmbedding with world_size={tp} must run "
-                f"inside shard_map with axis {self.axis_name!r} bound"
-            )
         start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
             per_partition, rank, tp
         )
@@ -138,10 +137,29 @@ class VocabParallelEmbedding(nn.Module):
         local = ids - start
         in_range = (local >= 0) & (local < per_partition)
         local = jnp.clip(local, 0, per_partition - 1)
-        out = jnp.take(weight, local, axis=0).astype(self.dtype)
+        out = jnp.take(self.weight, local, axis=0).astype(self.dtype)
         out = jnp.where(in_range[..., None], out, 0)
         return mappings.reduce_from_tensor_model_parallel_region(
             out, self.axis_name
+        )
+
+    def attend(self, hidden: jnp.ndarray) -> jnp.ndarray:
+        """Project hidden states onto the (local slice of the) vocabulary
+        with the tied embedding weight: the Megatron
+        ``parallel_lm_logits`` head (reference:
+        apex/transformer/testing/standalone_gpt.py output layer — logits
+        stay vocab-parallel, to be consumed by
+        vocab_parallel_cross_entropy)."""
+        tp = _resolve_world_size(self.world_size)
+        if tp > 1:
+            _require_axis(self.axis_name, tp, "VocabParallelEmbedding")
+            hidden = mappings.copy_to_tensor_model_parallel_region(
+                hidden, self.axis_name
+            )
+        return jnp.dot(
+            hidden,
+            self.weight.astype(hidden.dtype).T,
+            preferred_element_type=hidden.dtype,
         )
 
 

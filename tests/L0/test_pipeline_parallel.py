@@ -1,0 +1,434 @@
+"""Tests for pipeline parallelism: schedules, p2p, microbatches, scaler.
+
+Mirrors the reference's pipeline tests
+(reference: tests/L0/run_transformer/run_pipeline_parallel_test.py —
+toy-model runs of all three schedules — and
+run_dynamic_batchsize_test.py for the rampup calculator) on the
+CPU-simulated mesh. The core assertion everywhere: the pipelined loss
+and gradients equal the serial (no-parallelism) computation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from rocm_apex_tpu.transformer.pipeline_parallel import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    p2p_communication,
+)
+from rocm_apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.transformer.amp import GradScaler, sync_found_inf
+
+PP = 4
+D = 8  # feature dim
+MB = 2  # microbatch size
+M = 8  # num microbatches
+
+
+def stage_fn(params, x):
+    """One toy stage: tanh(x @ w + b) (the analogue of the reference's
+    one-linear-layer MyModel, apex/transformer/testing/commons.py:31-60)."""
+    w, b = params["w"], params["b"]
+    return jnp.tanh(x @ w + b)
+
+
+def loss_fn(y, target):
+    return jnp.mean((y - target) ** 2)
+
+
+def make_data(key, n_stages=PP):
+    kw, kb, kx, kt = jax.random.split(key, 4)
+    params = {
+        "w": jax.random.normal(kw, (n_stages, D, D)) / np.sqrt(D),
+        "b": jax.random.normal(kb, (n_stages, D)) * 0.1,
+    }
+    inputs = jax.random.normal(kx, (M, MB, D))
+    targets = jax.random.normal(kt, (M, MB, D))
+    return params, inputs, targets
+
+
+def serial_reference(params, inputs, targets, n_stages):
+    """Un-pipelined ground truth."""
+
+    def total_loss(p):
+        def one(mb_x, mb_t):
+            x = mb_x
+            for s in range(n_stages):
+                x = stage_fn(jax.tree_util.tree_map(lambda v: v[s], p), x)
+            return loss_fn(x, mb_t)
+
+        losses = jax.vmap(one)(inputs, targets)
+        return jnp.mean(losses), losses
+
+    (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(params)
+    return loss, losses, grads
+
+
+def pipe_mesh(devs, p=PP):
+    return Mesh(np.array(devs[:p]), ("pipe",))
+
+
+class TestNoPipelining:
+    def test_matches_serial(self):
+        params, inputs, targets = make_data(jax.random.PRNGKey(0), n_stages=1)
+        flat = jax.tree_util.tree_map(lambda v: v[0], params)
+
+        losses, grads = forward_backward_no_pipelining(
+            stage_fn, loss_fn, flat, inputs, targets
+        )
+        _, exp_losses, exp_grads = serial_reference(params, inputs, targets, 1)
+        np.testing.assert_allclose(losses, exp_losses, rtol=1e-5)
+        np.testing.assert_allclose(
+            grads["w"], exp_grads["w"][0], rtol=1e-4, atol=1e-6
+        )
+
+    def test_forward_only(self):
+        params, inputs, targets = make_data(jax.random.PRNGKey(1), n_stages=1)
+        flat = jax.tree_util.tree_map(lambda v: v[0], params)
+        losses, grads = forward_backward_no_pipelining(
+            stage_fn, loss_fn, flat, inputs, targets, forward_only=True
+        )
+        assert grads is None
+        assert losses.shape == (M,)
+
+
+class TestPipelining1F1B:
+    @pytest.mark.parametrize("checkpoint_stages", [False, True])
+    def test_matches_serial(self, eight_devices, checkpoint_stages):
+        mesh = pipe_mesh(eight_devices)
+        params, inputs, targets = make_data(jax.random.PRNGKey(2))
+
+        def local(p, x, t):
+            losses, grads = forward_backward_pipelining_without_interleaving(
+                stage_fn,
+                loss_fn,
+                p,
+                x,
+                t,
+                axis_name="pipe",
+                checkpoint_stages=checkpoint_stages,
+            )
+            return losses, grads
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P("pipe"), P(), P()),
+            out_specs=(P(), P("pipe")),
+        )
+        losses, grads = jax.jit(f)(params, inputs, targets)
+        _, exp_losses, exp_grads = serial_reference(params, inputs, targets, PP)
+        np.testing.assert_allclose(losses, exp_losses, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(grads["w"], exp_grads["w"], rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(grads["b"], exp_grads["b"], rtol=1e-4, atol=1e-6)
+
+    def test_forward_only(self, eight_devices):
+        mesh = pipe_mesh(eight_devices)
+        params, inputs, targets = make_data(jax.random.PRNGKey(3))
+        f = shard_map(
+            lambda p, x, t: forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, p, x, t, axis_name="pipe", forward_only=True
+            )[0],
+            mesh=mesh,
+            in_specs=(P("pipe"), P(), P()),
+            out_specs=P(),
+        )
+        losses = f(params, inputs, targets)
+        _, exp_losses, _ = serial_reference(params, inputs, targets, PP)
+        np.testing.assert_allclose(losses, exp_losses, rtol=1e-5, atol=1e-6)
+
+
+class TestPipeliningInterleaved:
+    def test_matches_serial(self, eight_devices):
+        """vp=2 chunks per stage over PP=4 devices = 8 global stages;
+        chunk v on device s is global stage v*PP+s."""
+        vp = 2
+        mesh = pipe_mesh(eight_devices)
+        params, inputs, targets = make_data(
+            jax.random.PRNGKey(4), n_stages=vp * PP
+        )
+        # (vp*P, ...) -> (vp, P, ...) so axis 1 shards over pipe.
+        chunked = jax.tree_util.tree_map(
+            lambda v: v.reshape((vp, PP) + v.shape[1:]), params
+        )
+
+        def local(p, x, t):
+            p = jax.tree_util.tree_map(lambda v: jnp.squeeze(v, 1), p)
+            losses, grads = forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, p, x, t, axis_name="pipe"
+            )
+            grads = jax.tree_util.tree_map(lambda v: v[:, None], grads)
+            return losses, grads
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(None, "pipe"), P(), P()),
+            out_specs=(P(), P(None, "pipe")),
+        )
+        losses, grads = jax.jit(f)(chunked, inputs, targets)
+        _, exp_losses, exp_grads = serial_reference(
+            params, inputs, targets, vp * PP
+        )
+        np.testing.assert_allclose(losses, exp_losses, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            grads["w"].reshape(exp_grads["w"].shape),
+            exp_grads["w"],
+            rtol=1e-4,
+            atol=1e-6,
+        )
+
+    def test_requires_divisible_microbatches(self, eight_devices):
+        mesh = pipe_mesh(eight_devices)
+        params, inputs, targets = make_data(jax.random.PRNGKey(5), n_stages=PP)
+        chunked = jax.tree_util.tree_map(
+            lambda v: v.reshape((1, PP) + v.shape[1:]), params
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            shard_map(
+                lambda p, x, t: forward_backward_pipelining_with_interleaving(
+                    stage_fn,
+                    loss_fn,
+                    jax.tree_util.tree_map(lambda v: jnp.squeeze(v, 1), p),
+                    x,
+                    t,
+                    axis_name="pipe",
+                )[0],
+                mesh=mesh,
+                in_specs=(P(None, "pipe"), P(), P()),
+                out_specs=P(),
+            )(chunked, inputs[: M - 1], targets[: M - 1])
+
+
+class TestDispatcher:
+    def test_selects_schedule(self, eight_devices):
+        parallel_state.initialize_model_parallel(
+            1, 4, devices=eight_devices[:4]
+        )
+        assert (
+            get_forward_backward_func(None, 4)
+            is forward_backward_pipelining_without_interleaving
+        )
+        assert (
+            get_forward_backward_func(2, 4)
+            is forward_backward_pipelining_with_interleaving
+        )
+        assert get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+        # falls back to parallel_state when pp size not given
+        assert (
+            get_forward_backward_func()
+            is forward_backward_pipelining_without_interleaving
+        )
+
+
+class TestP2P:
+    def test_send_forward_shifts(self, eight_devices):
+        mesh = pipe_mesh(eight_devices)
+        x = jnp.arange(PP, dtype=jnp.float32).reshape(PP, 1)
+        f = shard_map(
+            lambda v: p2p_communication.send_forward(v, "pipe"),
+            mesh=mesh,
+            in_specs=P("pipe"),
+            out_specs=P("pipe"),
+        )
+        out = np.asarray(f(x)).ravel()
+        np.testing.assert_array_equal(out, [0.0, 0.0, 1.0, 2.0])
+
+    def test_send_backward_shifts(self, eight_devices):
+        mesh = pipe_mesh(eight_devices)
+        x = jnp.arange(PP, dtype=jnp.float32).reshape(PP, 1)
+        f = shard_map(
+            lambda v: p2p_communication.send_backward(v, "pipe"),
+            mesh=mesh,
+            in_specs=P("pipe"),
+            out_specs=P("pipe"),
+        )
+        out = np.asarray(f(x)).ravel()
+        np.testing.assert_array_equal(out, [1.0, 2.0, 3.0, 0.0])
+
+    def test_ring_forward_wraps(self, eight_devices):
+        mesh = pipe_mesh(eight_devices)
+        x = jnp.arange(PP, dtype=jnp.float32).reshape(PP, 1)
+        f = shard_map(
+            lambda v: p2p_communication.ring_forward(v, "pipe"),
+            mesh=mesh,
+            in_specs=P("pipe"),
+            out_specs=P("pipe"),
+        )
+        out = np.asarray(f(x)).ravel()
+        np.testing.assert_array_equal(out, [3.0, 0.0, 1.0, 2.0])
+
+    def test_scatter_gather_roundtrip(self, eight_devices):
+        """Scatter-gather transfer == plain transfer
+        (reference: p2p_communication.py:116-119,152-157 — a bandwidth
+        optimization that must not change values)."""
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("pipe", "tensor"))
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 8))
+
+        def local(v):
+            plain = p2p_communication.send_forward(v, "pipe")
+            sg = p2p_communication.send_forward(
+                v,
+                "pipe",
+                scatter_gather_tensors_in_pipeline=True,
+                tensor_axis="tensor",
+            )
+            return plain, sg
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=P("pipe"),
+            out_specs=(P("pipe"), P("pipe")),
+            check_rep=False,
+        )
+        plain, sg = f(x)
+        np.testing.assert_allclose(np.asarray(plain), np.asarray(sg), rtol=1e-6)
+
+
+class TestMicrobatchCalculators:
+    def test_constant(self):
+        c = ConstantNumMicroBatches(256, 4, 8)
+        assert c.get() == 8
+        assert c.get_current_global_batch_size() == 256
+        c.update(10_000, True)
+        assert c.get() == 8
+
+    def test_constant_divisibility_error(self):
+        with pytest.raises(ValueError, match="divisible"):
+            ConstantNumMicroBatches(250, 4, 8)
+
+    def test_rampup(self):
+        """Linear ramp semantics (reference: microbatches.py:101-172)."""
+        r = RampupBatchsizeNumMicroBatches(
+            start_batch_size=32,
+            batch_size_increment=32,
+            rampup_samples=960,
+            global_batch_size=256,
+            micro_batch_size=4,
+            data_parallel_size=1,
+        )
+        # 7 increments of 32, ~137 samples each
+        assert r.get_current_global_batch_size() == 32
+        assert r.get() == 8
+        r.update(140, True)
+        assert r.get_current_global_batch_size() == 64
+        r.update(961, True)
+        assert r.get_current_global_batch_size() == 256
+        assert r.get() == 64
+
+    def test_factory(self):
+        c = build_num_microbatches_calculator(0, None, 64, 2, 4)
+        assert isinstance(c, ConstantNumMicroBatches)
+        r = build_num_microbatches_calculator(0, [32, 32, 100], 64, 2, 4)
+        assert isinstance(r, RampupBatchsizeNumMicroBatches)
+
+    def test_singleton(self):
+        pp_utils.setup_microbatch_calculator(0, None, 64, 2, 4)
+        assert pp_utils.get_num_microbatches() == 8
+        assert pp_utils.get_current_global_batch_size() == 64
+        assert pp_utils.get_micro_batch_size() == 2
+        with pytest.raises(RuntimeError, match="already initialized"):
+            pp_utils.setup_microbatch_calculator(0, None, 64, 2, 4)
+
+
+class TestModelParallelGradScaler:
+    def test_found_inf_syncs_across_tensor_axis(self, eight_devices):
+        """If one TP rank overflows, every rank must skip
+        (reference: apex/transformer/amp/grad_scaler.py:25-36)."""
+        mesh = Mesh(np.array(eight_devices[:4]), ("tensor",))
+        scaler = GradScaler(axis_names=("tensor",))
+        state = scaler.init()
+        # only rank 2 sees an overflow
+        local_inf = jnp.array([False, False, True, False])
+
+        def local(s, inf):
+            new_state, skip = scaler.update(s, inf[0])
+            return new_state, jnp.reshape(skip, (1,))
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P("tensor")),
+            out_specs=(P(), P("tensor")),
+        )
+        new_state, skip = f(state, local_inf)
+        assert bool(np.asarray(skip).all()), "every rank must skip"
+        assert float(new_state.loss_scale) == 2.0**15
+
+    def test_sync_found_inf_no_axis_is_identity(self):
+        assert bool(sync_found_inf(jnp.asarray(True), ())) is True
+
+    def test_rejects_asymmetric_factors(self):
+        with pytest.raises(ValueError, match="backoff_factor"):
+            GradScaler(growth_factor=2.0, backoff_factor=0.25)
+
+
+class TestPipelineUtils:
+    def test_average_losses_across_dp(self, eight_devices):
+        mesh = Mesh(np.array(eight_devices), ("data",))
+        losses = jnp.arange(8.0).reshape(8, 1)
+        f = shard_map(
+            lambda l: pp_utils.average_losses_across_data_parallel_group(
+                [l[0]], "data"
+            ),
+            mesh=mesh,
+            in_specs=P("data"),
+            out_specs=P(),
+        )
+        np.testing.assert_allclose(np.asarray(f(losses)), [3.5])
+
+    def test_params_l2_norm_across_tp(self, eight_devices):
+        mesh = Mesh(np.array(eight_devices[:4]), ("tensor",))
+        w = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+
+        f = shard_map(
+            lambda v: pp_utils.calc_params_l2_norm(
+                {"w": v}, model_axis_names=("tensor",)
+            ),
+            mesh=mesh,
+            in_specs=P("tensor"),
+            out_specs=P(),
+        )
+        np.testing.assert_allclose(
+            float(f(w)), float(jnp.linalg.norm(w)), rtol=1e-5
+        )
+
+    def test_ltor_masks_basic(self):
+        data = jnp.array([[5, 1, 7, 1, 3]])
+        mask, loss_mask, pos = pp_utils.get_ltor_masks_and_position_ids(
+            data, eod_token=1, eod_mask_loss=True
+        )
+        assert mask.shape == (1, 1, 5, 5)
+        # strictly-causal: position 0 attends only to itself
+        assert not mask[0, 0, 0, 0] and mask[0, 0, 0, 1]
+        np.testing.assert_allclose(loss_mask[0], [1, 0, 1, 0, 1])
+        np.testing.assert_array_equal(pos[0], [0, 1, 2, 3, 4])
+
+    def test_ltor_masks_resets(self):
+        """Document resets match the reference's loop semantics
+        (reference: utils.py:279-333)."""
+        data = jnp.array([[5, 1, 7, 8, 1, 3]])
+        mask, _, pos = pp_utils.get_ltor_masks_and_position_ids(
+            data,
+            eod_token=1,
+            reset_position_ids=True,
+            reset_attention_mask=True,
+        )
+        # positions restart after each EOD (index of EOD + 1)
+        np.testing.assert_array_equal(pos[0], [0, 1, 0, 1, 2, 0])
+        # token 2 (first of doc 2) must not attend to doc 1 (tokens 0-1)
+        assert mask[0, 0, 2, 0] and mask[0, 0, 2, 1]
+        assert not mask[0, 0, 3, 2]
+        # token 5 (doc 3) must not attend to anything before it
+        assert mask[0, 0, 5, 4] and not mask[0, 0, 5, 5]

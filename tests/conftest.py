@@ -39,9 +39,11 @@ def _reset_global_state():
     yield
     from rocm_apex_tpu import amp
     from rocm_apex_tpu.transformer import parallel_state
+    from rocm_apex_tpu.transformer.pipeline_parallel import utils as pp_utils
 
     parallel_state.destroy_model_parallel()
     amp.init(None)
+    pp_utils._destroy_microbatch_calculator()
 
 
 @pytest.fixture
