@@ -371,8 +371,10 @@ class GPTModel(nn.Module):
         logits = self.embedding.attend(x)
         if labels is None:
             return logits
-        tp = self.cfg.tensor_parallel_size or 1
-        if tp > 1 or parallel_state.model_parallel_is_initialized():
+        tp = self.cfg.tensor_parallel_size
+        if tp is None and parallel_state.model_parallel_is_initialized():
+            tp = parallel_state.get_tensor_model_parallel_world_size()
+        if (tp or 1) > 1:
             losses = vocab_parallel_cross_entropy(
                 logits.astype(jnp.float32), labels, self.cfg.tensor_axis
             )

@@ -135,9 +135,15 @@ def _ln_bwd_kernel(affine, x_ref, dy_ref, mu_ref, rs_ref, *refs):
     if affine:
         g = g_ref[...].astype(jnp.float32)
         dyg = dy * g
-        # per-block partials for the two-stage gamma/beta reduction
-        dg_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-        db_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+        # per-block partials for the two-stage gamma/beta reduction,
+        # padded to a full 8-sublane tile (row 0 holds the partial)
+        pad = jnp.zeros((7, x.shape[1]), jnp.float32)
+        dg_ref[...] = jnp.concatenate(
+            [jnp.sum(dy * xhat, axis=0, keepdims=True), pad]
+        )
+        db_ref[...] = jnp.concatenate(
+            [jnp.sum(dy, axis=0, keepdims=True), pad]
+        )
     else:
         dyg = dy
     c1 = jnp.mean(dyg, axis=1, keepdims=True)
@@ -174,12 +180,12 @@ def _layer_norm_bwd(affine, eps, res, dy):
         ins.append(weight.reshape(1, hidden).astype(kernel_dtype(weight.dtype)))
         in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0)))
         out_specs += [
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((8, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((8, hidden), lambda i: (i, 0)),
         ]
         out_shape += [
-            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((grid * 8, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((grid * 8, hidden), jnp.float32),
         ]
 
     outs = pallas_call(

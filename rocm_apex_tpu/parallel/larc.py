@@ -103,7 +103,11 @@ class LARC:
                 "instead)"
             )
         self._tx = larc(
-            lr=float(inferred_lr) if inferred_lr is not None else 1.0,
+            lr=(
+                float(inferred_lr)
+                if inferred_lr is not None and not callable(inferred_lr)
+                else 1.0
+            ),
             trust_coefficient=trust_coefficient,
             clip=clip,
             eps=eps,

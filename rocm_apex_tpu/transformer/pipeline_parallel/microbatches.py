@@ -135,7 +135,7 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
         self.update(0, False)
 
     def update(self, consumed_samples: int, consistency_check: bool) -> None:
-        if consumed_samples > self.rampup_samples:
+        if consumed_samples >= self.rampup_samples:
             self.current_global_batch_size = self.global_batch_size
         else:
             steps = int(consumed_samples / self.rampup_samples_per_increment)
