@@ -1,0 +1,9 @@
+"""contrib — the experimental/perf tier of the framework.
+
+TPU-native rebuilds of the reference's `apex.contrib` packages
+(reference: apex/contrib/ — SURVEY.md §2.6/§2.8): ZeRO-style
+distributed optimizers, fused attention (flash), fused softmax
+cross-entropy, transducer, group BN, ASP structured sparsity.
+Each subpackage is importable on its own, mirroring the reference's
+one-package-per-kernel-family layout.
+"""

@@ -1,0 +1,23 @@
+"""ZeRO-style distributed optimizers.
+
+Reference: apex/contrib/optimizers/distributed_fused_adam.py,
+distributed_fused_lamb.py (SURVEY.md §2.6).
+"""
+
+from rocm_apex_tpu.contrib.optimizers.distributed import (  # noqa: F401
+    DistributedAdamState,
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    DistributedLAMBState,
+    distributed_fused_adam,
+    distributed_fused_lamb,
+)
+
+__all__ = [
+    "distributed_fused_adam",
+    "distributed_fused_lamb",
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
+    "DistributedAdamState",
+    "DistributedLAMBState",
+]

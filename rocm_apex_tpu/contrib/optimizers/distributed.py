@@ -1,0 +1,447 @@
+"""ZeRO-style distributed fused optimizers over the data axis.
+
+TPU-native redesign of the reference's sharded-optimizer family
+(reference: apex/contrib/optimizers/distributed_fused_adam.py:9-636 and
+distributed_fused_lamb.py:6-910). The reference flattens all grads into
+one buffer split into blocks/chunks/shards, overlaps **reduce-scatter**
+with backward via per-param hooks, keeps each rank's shard of fp32
+master params + moments, and **all-gathers** the updated fp16 params
+after the step (optionally e5m2-compressed).
+
+Here the same dataflow is three XLA collectives over the ``data`` mesh
+axis inside `shard_map`, applied to the packed dtype-group buffers
+(ops/packing.py):
+
+    grads  --psum_scatter-->  grad shard           (the reduce-scatter)
+    shard update: fused Adam/LAMB Pallas kernel on the rank's shard of
+        fp32 masters + moments
+    new masters --all_gather--> full fp32 buffers --> updates pytree
+
+Knob collapse relative to the reference (SURVEY.md §7): the
+blocks/chunks/process-group plumbing (`dwu_num_blocks=4,
+dwu_num_chunks=4`, rs/ar/ag group counts, reference
+distributed_fused_adam.py:55-127) exists to hand-overlap NCCL with
+bprop; XLA's latency-hiding scheduler owns that here, so the knobs are
+gone. `predivide` (reference `predivide=True`) survives: divide grads
+by world size before the reduce-scatter (overflow-safe) vs fold 1/N
+into the kernel's grad_scale after.
+
+Both transformations must run where the data axis is bound (inside
+`shard_map`, or under pmap with the same axis name). Every rank passes
+its FULL (unreduced) local grads — the reduce-scatter here replaces the
+DDP allreduce; do not pre-average.
+
+The returned updates are exact master-driven deltas: applying them with
+`optax.apply_updates` makes the model params bitwise equal to the cast
+of the fp32 masters — the semantics of the reference's post-step
+all-gather of fp16 params from fp32 shards.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rocm_apex_tpu.ops import optim_kernels
+from rocm_apex_tpu.ops.multi_tensor import row_sumsq
+from rocm_apex_tpu.ops.optim_kernels import BLOCK_ROWS
+from rocm_apex_tpu.ops.packing import group_segment_ids, respec
+from rocm_apex_tpu.optimizers import _common as c
+from rocm_apex_tpu.transformer import parallel_state
+
+__all__ = [
+    "distributed_fused_adam",
+    "distributed_fused_lamb",
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
+    "DistributedAdamState",
+    "DistributedLAMBState",
+]
+
+
+class DistributedAdamState(NamedTuple):
+    count: jnp.ndarray
+    master: Tuple[jnp.ndarray, ...]  # fp32 (rows/N, WIDTH) shards
+    m: Tuple[jnp.ndarray, ...]
+    v: Tuple[jnp.ndarray, ...]
+
+
+class DistributedLAMBState(NamedTuple):
+    count: jnp.ndarray
+    master: Tuple[jnp.ndarray, ...]
+    m: Tuple[jnp.ndarray, ...]
+    v: Tuple[jnp.ndarray, ...]
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _shard_meta(spec, axis_name):
+    """(world, rank, [(rows_padded, shard_rows) per group])."""
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    dims = []
+    for g in spec.groups:
+        rows_pad = _round_up(g.rows, BLOCK_ROWS * world)
+        dims.append((rows_pad, rows_pad // world))
+    return world, rank, dims
+
+
+def _pad_rows_to(buf, rows_pad):
+    if buf.shape[0] == rows_pad:
+        return buf
+    return jnp.pad(buf, ((0, rows_pad - buf.shape[0]), (0, 0)))
+
+
+def _slice_shard(buf, rank, shard_rows):
+    return jax.lax.dynamic_slice_in_dim(buf, rank * shard_rows, shard_rows, 0)
+
+
+def _master_shards(spec, params, axis_name):
+    from rocm_apex_tpu.ops.packing import pack_tree
+
+    world, rank, dims = _shard_meta(spec, axis_name)
+    pp = pack_tree(params, spec)
+    shards = []
+    for pbuf, (rows_pad, shard_rows) in zip(pp.buffers, dims):
+        full = _pad_rows_to(pbuf.astype(jnp.float32), rows_pad)
+        shards.append(_slice_shard(full, rank, shard_rows))
+    return tuple(shards)
+
+
+def _scatter_grads(pg, dims, axis_name, world, predivide):
+    """reduce-scatter each fp32 grad buffer into this rank's shard."""
+    shards = []
+    for gbuf, (rows_pad, _) in zip(pg.buffers, dims):
+        g = _pad_rows_to(gbuf, rows_pad)
+        if predivide:
+            g = g / world
+        shards.append(
+            jax.lax.psum_scatter(
+                g, axis_name, scatter_dimension=0, tiled=True
+            )
+        )
+    return shards
+
+
+def _emit_updates(spec, pp, new_masters, dims, axis_name):
+    """all-gather new master shards; updates make p + u == cast(master)."""
+    deltas = []
+    for pbuf, master, (rows_pad, _) in zip(pp.buffers, new_masters, dims):
+        full = jax.lax.all_gather(master, axis_name, axis=0, tiled=True)
+        full = full[: pbuf.shape[0]]
+        deltas.append(full - pbuf.astype(jnp.float32))
+    return c.deltas_to_updates(spec, deltas)
+
+
+def _wd_shards(spec, weight_decay, mask, dims, rank):
+    cols = c.wd_columns(spec, weight_decay, mask)
+    out = []
+    for col, (rows_pad, shard_rows) in zip(cols, dims):
+        padded = jnp.pad(col, ((0, rows_pad - col.shape[0]), (0, 0)))
+        out.append(_slice_shard(padded, rank, shard_rows))
+    return out
+
+
+def _global_grad_sumsq(grad_shards, axis_name):
+    """Shards are disjoint after the reduce-scatter, so the global grad
+    L2 norm is the psum of per-shard row-sumsq totals (the analogue of
+    the reference's compute_L2_grad_norm allreduce,
+    distributed_fused_adam.py:55-127)."""
+    local = jnp.asarray(0.0, jnp.float32)
+    for g in grad_shards:
+        local = local + row_sumsq(g).sum()
+    return jax.lax.psum(local, axis_name)
+
+
+def distributed_fused_adam(
+    learning_rate: c.ScalarOrSchedule = 1e-3,
+    *,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    adam_w_mode: bool = True,
+    weight_decay: float = 0.0,
+    weight_decay_mask: Optional[Any] = None,
+    grad_scale: Optional[Any] = None,
+    max_grad_norm: float = 0.0,
+    predivide: bool = True,
+    axis_name: str = parallel_state.DATA_AXIS,
+) -> optax.GradientTransformation:
+    """ZeRO-sharded fused Adam over `axis_name`.
+
+    Hyperparameter semantics match `fused_adam` / the reference
+    (reference: apex/contrib/optimizers/distributed_fused_adam.py:55-127);
+    `max_grad_norm > 0` enables the fused global-norm clip
+    (`clip_grad_norm=True` there). Must run with `axis_name` bound.
+    """
+    beta1, beta2 = betas
+
+    def init_fn(params):
+        spec = c.build_pack_spec(params)
+        world, _, dims = _shard_meta(spec, axis_name)
+        zeros = tuple(
+            jnp.zeros((shard_rows, optim_kernels.WIDTH), jnp.float32)
+            for (_, shard_rows) in dims
+        )
+        return DistributedAdamState(
+            count=jnp.zeros((), jnp.int32),
+            master=_master_shards(spec, params, axis_name),
+            m=zeros,
+            v=zeros,
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("distributed_fused_adam requires params in update()")
+        spec, pp, pg = c.pack_params_and_grads(params, grads)
+        world, rank, dims = _shard_meta(spec, axis_name)
+
+        count = state.count + 1
+        lr = c.resolve_lr(learning_rate, count)
+        t = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - beta1**t
+            bc2 = 1.0 - beta2**t
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        g_shards = _scatter_grads(pg, dims, axis_name, world, predivide)
+        gs = jnp.asarray(1.0 if grad_scale is None else grad_scale, jnp.float32)
+        if not predivide:
+            gs = gs / world
+        if max_grad_norm and max_grad_norm > 0:
+            gnorm = jnp.sqrt(_global_grad_sumsq(g_shards, axis_name)) * gs
+            gs = gs * jnp.where(gnorm > max_grad_norm, max_grad_norm / gnorm, 1.0)
+
+        wd_shards = _wd_shards(spec, weight_decay, weight_decay_mask, dims, rank)
+
+        new_master, new_m, new_v = [], [], []
+        for mast, gsh, mbuf, vbuf, wd in zip(
+            state.master, g_shards, state.m, state.v, wd_shards
+        ):
+            d, m2, v2 = optim_kernels.adam_update(
+                mast, gsh, mbuf, vbuf, wd,
+                [lr, beta1, beta2, eps, bc1, bc2, gs],
+                adam_w_mode,
+            )
+            new_master.append(mast + d)
+            new_m.append(m2)
+            new_v.append(v2)
+
+        updates = _emit_updates(spec, pp, new_master, dims, axis_name)
+        return updates, DistributedAdamState(
+            count=count,
+            master=tuple(new_master),
+            m=tuple(new_m),
+            v=tuple(new_v),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def distributed_fused_lamb(
+    learning_rate: c.ScalarOrSchedule = 1e-3,
+    *,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    grad_averaging: bool = True,
+    adam_w_mode: bool = True,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+    weight_decay_mask: Optional[Any] = None,
+    grad_scale: Optional[Any] = None,
+    predivide: bool = True,
+    axis_name: str = parallel_state.DATA_AXIS,
+) -> optax.GradientTransformation:
+    """ZeRO-sharded fused LAMB over `axis_name`.
+
+    The per-tensor trust ratios ||p||/||u|| are computed from sharded
+    buffers: each rank's segmented partial sums are psummed over the
+    axis, exactly reproducing the unsharded `fused_lamb` math
+    (reference: apex/contrib/optimizers/distributed_fused_lamb.py:6-910,
+    whose per-tensor norms ride a dedicated l2-norm kernel + allreduce).
+    """
+    beta1, beta2 = betas
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+
+    def init_fn(params):
+        spec = c.build_pack_spec(params)
+        world, _, dims = _shard_meta(spec, axis_name)
+        zeros = tuple(
+            jnp.zeros((shard_rows, optim_kernels.WIDTH), jnp.float32)
+            for (_, shard_rows) in dims
+        )
+        return DistributedLAMBState(
+            count=jnp.zeros((), jnp.int32),
+            master=_master_shards(spec, params, axis_name),
+            m=zeros,
+            v=zeros,
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("distributed_fused_lamb requires params in update()")
+        spec, pp, pg = c.pack_params_and_grads(params, grads)
+        world, rank, dims = _shard_meta(spec, axis_name)
+
+        count = state.count + 1
+        lr = c.resolve_lr(learning_rate, count)
+        t = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - beta1**t
+            bc2 = 1.0 - beta2**t
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        g_shards = _scatter_grads(pg, dims, axis_name, world, predivide)
+        gs = jnp.asarray(1.0 if grad_scale is None else grad_scale, jnp.float32)
+        if not predivide:
+            gs = gs / world
+        gnorm = jnp.sqrt(_global_grad_sumsq(g_shards, axis_name)) * gs
+        if max_grad_norm and max_grad_norm > 0:
+            clip = jnp.where(gnorm > max_grad_norm, max_grad_norm / gnorm, 1.0)
+        else:
+            clip = jnp.asarray(1.0, jnp.float32)
+
+        wd_shards = _wd_shards(spec, weight_decay, weight_decay_mask, dims, rank)
+        wd_vals = c.wd_per_tensor(spec, weight_decay, weight_decay_mask)
+
+        new_master, new_m, new_v = [], [], []
+        for mast, gsh, mbuf, vbuf, wd, wdv, group, (rows_pad, shard_rows) in zip(
+            state.master, g_shards, state.m, state.v, wd_shards, wd_vals,
+            spec.groups, dims,
+        ):
+            u, m2, v2 = optim_kernels.lamb_stage1(
+                mast, gsh, mbuf, vbuf, wd,
+                [beta1, beta2, beta3, eps, bc1, bc2, gs, clip],
+                adam_w_mode,
+            )
+            # sharded per-tensor norms: local segmented partials + psum
+            n_t = len(group.leaf_specs)
+            ids = np.concatenate(
+                [
+                    group_segment_ids(group),
+                    np.full((rows_pad - group.rows,), n_t, np.int32),
+                ]
+            ).astype(np.int32)
+            ids_shard = _slice_shard(jnp.asarray(ids)[:, None], rank, shard_rows)[
+                :, 0
+            ]
+
+            def per_tensor(buf):
+                part = jax.ops.segment_sum(
+                    row_sumsq(buf)[:, 0], ids_shard, num_segments=n_t + 1
+                )[:n_t]
+                return jax.lax.psum(part, axis_name)
+
+            p_norm = jnp.sqrt(per_tensor(mast))
+            u_norm = jnp.sqrt(per_tensor(u))
+            ratio = jnp.where(
+                (p_norm > 0.0) & (u_norm > 0.0), p_norm / u_norm, 1.0
+            )
+            if not use_nvlamb:
+                # trust ratio only for decayed tensors (reference
+                # multi_tensor_lamb.cu:255-262)
+                eligible = jnp.asarray(np.asarray(wdv) != 0.0)
+                ratio = jnp.where(eligible, ratio, 1.0)
+            padded = jnp.concatenate([ratio, jnp.ones((1,), ratio.dtype)])
+            ratio_col = padded[ids_shard][:, None]
+            (d,) = optim_kernels.lamb_stage2(u, ratio_col, [lr])
+            new_master.append(mast + d)
+            new_m.append(m2)
+            new_v.append(v2)
+
+        updates = _emit_updates(spec, pp, new_master, dims, axis_name)
+        return updates, DistributedLAMBState(
+            count=count,
+            master=tuple(new_master),
+            m=tuple(new_m),
+            v=tuple(new_v),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class DistributedFusedAdam(c.FusedOptimizer):
+    """Class facade (reference: distributed_fused_adam.py:9-127; the
+    dwu_* overlap knobs are subsumed by the XLA scheduler)."""
+
+    def __init__(
+        self,
+        lr: c.ScalarOrSchedule = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        max_grad_norm: float = 0.0,
+        predivide: bool = True,
+        weight_decay_mask: Optional[Any] = None,
+        axis_name: str = parallel_state.DATA_AXIS,
+    ):
+        if amsgrad:
+            raise RuntimeError(
+                "DistributedFusedAdam does not support the AMSGrad variant."
+            )
+        super().__init__(
+            distributed_fused_adam(
+                lr,
+                bias_correction=bias_correction,
+                betas=betas,
+                eps=eps,
+                adam_w_mode=adam_w_mode,
+                weight_decay=weight_decay,
+                weight_decay_mask=weight_decay_mask,
+                max_grad_norm=max_grad_norm,
+                predivide=predivide,
+                axis_name=axis_name,
+            )
+        )
+
+
+class DistributedFusedLAMB(c.FusedOptimizer):
+    """Class facade (reference: distributed_fused_lamb.py:6-910)."""
+
+    def __init__(
+        self,
+        lr: c.ScalarOrSchedule = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        predivide: bool = True,
+        weight_decay_mask: Optional[Any] = None,
+        axis_name: str = parallel_state.DATA_AXIS,
+    ):
+        if amsgrad:
+            raise RuntimeError(
+                "DistributedFusedLAMB does not support the AMSGrad variant."
+            )
+        super().__init__(
+            distributed_fused_lamb(
+                lr,
+                bias_correction=bias_correction,
+                betas=betas,
+                eps=eps,
+                weight_decay=weight_decay,
+                grad_averaging=grad_averaging,
+                adam_w_mode=adam_w_mode,
+                max_grad_norm=max_grad_norm,
+                use_nvlamb=use_nvlamb,
+                predivide=predivide,
+                weight_decay_mask=weight_decay_mask,
+                axis_name=axis_name,
+            )
+        )
