@@ -1,12 +1,23 @@
 """Driver benchmark: one JSON line on stdout.
 
 Measures the flagship config on whatever single chip is available: a
-Megatron-style GPT train step — bf16 compute + fp32 masters (the
-O5/amp-O2 recipe), fused-Adam Pallas update, dynamic loss scaling —
-reporting tokens/sec/chip. The reference publishes no numbers
-(SURVEY.md §6, BASELINE.json "published": {}), so ``vs_baseline`` is
-the ratio against the model-FLOPs roofline of the chip (i.e. MFU),
-the target BASELINE.md sets (>=70% MFU north star).
+Megatron-style GPT train step under the O5/amp-O2 recipe — bf16 model
+params computing with Pallas flash attention + fused CE, fp32 masters
+updated by the XLA-tree-fused mixed-precision Adam (optimizers/mixed.py
+— see its header for why tree fusion, not buffer packing, is the TPU
+fast path), dynamic loss scaling with jit-safe skip-step — reporting
+tokens/sec/chip.
+
+Timing notes:
+* ITERS steps run inside ONE dispatch via `lax.scan` — the axon tunnel
+  adds tens of ms of per-dispatch latency that real multi-step training
+  does not pay;
+* on the tunnel platform `block_until_ready` does NOT synchronize; the
+  timed region ends with a scalar value fetch.
+
+The reference publishes no numbers (SURVEY.md §6, BASELINE.json
+"published": {}), so ``vs_baseline`` is the ratio against BASELINE.md's
+north-star bar (70% MFU): vs_baseline = MFU / 0.70.
 """
 
 import json
@@ -16,118 +27,97 @@ import time
 import jax
 import jax.numpy as jnp
 
+from rocm_apex_tpu.amp import LossScaler, all_finite
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
-from rocm_apex_tpu.optimizers import fused_adam
-from rocm_apex_tpu.amp import LossScaler
-from rocm_apex_tpu.optimizers._common import tree_where
+from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
 
 BATCH = 8
 SEQ = 1024
-WARMUP = 2
-ITERS = 10
+ITERS = 10  # one warmup runN (compile + state settle) then one timed
 
 
 def peak_flops_per_chip() -> float:
-    """Best-effort bf16 peak for the local chip; CPU fallback is tiny."""
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "cpu").lower()
+    """Best-effort bf16 peak for the local chip; CPU fallback is nominal."""
+    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
     table = {
         "v6e": 918e12,
         "v6": 918e12,
         "v5p": 459e12,
+        "v5 lite": 197e12,
         "v5e": 197e12,
-        "v5": 197e12,
+        "v5": 459e12,
         "v4": 275e12,
     }
     for k, v in table.items():
         if k in kind:
             return v
-    return 1e12  # CPU / unknown: nominal
+    return 1e12
 
 
 def main():
+    on_tpu = jax.default_backend() == "tpu"
     cfg = GPTConfig(
-        vocab_size=32768,
-        hidden_size=1024,
-        num_layers=8,
-        num_attention_heads=16,
-        max_position_embeddings=SEQ,
+        vocab_size=32768 if on_tpu else 1024,
+        hidden_size=1024 if on_tpu else 128,
+        num_layers=8 if on_tpu else 2,
+        num_attention_heads=16 if on_tpu else 4,
+        max_position_embeddings=SEQ if on_tpu else 128,
         hidden_dropout=0.0,
         attention_dropout=0.0,
         tensor_parallel_size=1,
     )
-    if jax.default_backend() != "tpu":
-        # keep the CPU smoke run fast
-        cfg = GPTConfig(
-            vocab_size=1024,
-            hidden_size=128,
-            num_layers=2,
-            num_attention_heads=4,
-            max_position_embeddings=128,
-            hidden_dropout=0.0,
-            attention_dropout=0.0,
-            tensor_parallel_size=1,
-        )
     seq = min(SEQ, cfg.max_position_embeddings)
 
     model = GPTModel(cfg)
-    optimizer = fused_adam(1e-4, weight_decay=0.01)
+    opt = MixedPrecisionAdam(1e-4, weight_decay=0.01)
     scaler = LossScaler(loss_scale="dynamic")
 
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(key, (BATCH, seq), 0, cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
-    params = model.init(jax.random.PRNGKey(1), tokens[:1])
-    opt_state = optimizer.init(params)
-    scaler_state = scaler.init()
+    params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
+    state = opt.init(params32)
+    sstate = scaler.init()
+
+    def one_step(carry, _):
+        state, sstate = carry
+
+        def loss_fn(params):
+            losses = model.apply(params, tokens, labels=labels)
+            return gpt_loss_fn(losses) * scaler.loss_scale(sstate)
+
+        scaled, grads = jax.value_and_grad(loss_fn)(state.model)
+        found_inf = ~all_finite(grads)
+        sstate2, skip = scaler.update(sstate, found_inf)
+        inv_scale = 1.0 / scaler.loss_scale(sstate)
+        state2 = opt.step(state, grads, grad_scale=inv_scale, skip=skip)
+        return (state2, sstate2), scaled * inv_scale
 
     @jax.jit
-    def step(params, opt_state, scaler_state, tokens, labels):
-        def loss_fn(p):
-            losses = model.apply(p, tokens, labels=labels)
-            return gpt_loss_fn(losses) * scaler.loss_scale(scaler_state)
-
-        scaled, grads = jax.value_and_grad(loss_fn)(params)
-        grads, found_inf = scaler.unscale(scaler_state, grads)
-        scaler_state2, skip = scaler.update(scaler_state, found_inf)
-        updates, opt_state2 = optimizer.update(grads, opt_state, params)
-        new_params = jax.tree_util.tree_map(jnp.add, params, updates)
-        return (
-            tree_where(skip, params, new_params),
-            tree_where(skip, opt_state, opt_state2),
-            scaler_state2,
-            scaled / scaler.loss_scale(scaler_state),
+    def runN(state, sstate):
+        (state, sstate), losses = jax.lax.scan(
+            one_step, (state, sstate), None, length=ITERS
         )
+        return state, sstate, losses
 
-    # NOTE: on the axon tunnel platform block_until_ready does NOT wait
-    # for execution — only a value fetch synchronizes. Iterations chain
-    # through params, so one final scalar fetch bounds all ITERS steps.
-    for _ in range(WARMUP):
-        params, opt_state, scaler_state, loss = step(
-            params, opt_state, scaler_state, tokens, labels
-        )
-    float(loss)
+    state, sstate, losses = runN(state, sstate)
+    float(losses[-1])  # warmup + sync (value fetch, not block_until_ready)
 
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        params, opt_state, scaler_state, loss = step(
-            params, opt_state, scaler_state, tokens, labels
-        )
-    float(loss)
+    state, sstate, losses = runN(state, sstate)
+    loss = float(losses[-1])
     dt = (time.perf_counter() - t0) / ITERS
 
     tokens_per_sec = BATCH * seq / dt
-    # 6 * N_non-embedding * tokens (fwd+bwd) model FLOPs
     n_params = sum(
-        x.size for x in jax.tree_util.tree_leaves(params)
+        int(x.size) for x in jax.tree_util.tree_leaves(params32)
     ) - cfg.vocab_size * cfg.hidden_size
     model_flops = 6.0 * n_params * BATCH * seq + (
-        # attention score/context matmuls: 12 * b * s^2 * h per layer
         12.0 * cfg.num_layers * BATCH * seq * seq * cfg.hidden_size
     )
     mfu = (model_flops / dt) / peak_flops_per_chip()
     print(
-        f"step={dt*1000:.1f}ms loss={float(loss):.4f} mfu={mfu:.3f} "
+        f"step={dt*1000:.1f}ms loss={loss:.4f} mfu={mfu:.3f} "
         f"backend={jax.default_backend()}",
         file=sys.stderr,
     )

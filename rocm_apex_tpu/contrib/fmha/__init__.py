@@ -1,0 +1,10 @@
+"""Packed-varlen fused multi-head attention.
+
+Reference: apex/contrib/fmha/fmha.py:33-118 (FMHAFun/FMHA over packed
+qkv + cu_seqlens, seqlen <= 512). Here the core is the Pallas flash
+attention, so the seqlen bound is gone.
+"""
+
+from rocm_apex_tpu.contrib.fmha.fmha import FMHA, fmha  # noqa: F401
+
+__all__ = ["fmha", "FMHA"]

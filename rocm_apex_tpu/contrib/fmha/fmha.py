@@ -1,0 +1,77 @@
+"""Packed variable-length attention over cumulative sequence offsets.
+
+Rebuild of the reference FMHA
+(reference: apex/contrib/fmha/fmha.py:33-118 — qkv ``(total, 3, h, d)``
+packed along the token axis, ``cu_seqlens`` (b+1,) int32 prefix
+offsets, returns ``(total, h, d)``). The reference's hand-tiled kernels
+cap seqlen at 512 with `_nl` variants for small batch
+(apex/contrib/csrc/fmha/); this unpacks into a padded batch, runs the
+Pallas flash kernel with a per-sequence validity bias, and re-packs.
+The unpack/re-pack are gathers XLA fuses around the kernel; padded rows
+never reach HBM as attention scores (flash never materializes them).
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["fmha", "FMHA"]
+
+
+def _unpack_ids(cu_seqlens: jnp.ndarray, total: int, max_s: int):
+    """token -> (sequence, offset-within-sequence) for packed layouts."""
+    tok = jnp.arange(total)
+    seq_id = jnp.searchsorted(cu_seqlens[1:], tok, side="right")
+    offset = tok - cu_seqlens[seq_id]
+    return seq_id, offset
+
+
+def fmha(
+    qkv: jnp.ndarray,
+    cu_seqlens: jnp.ndarray,
+    max_s: int,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Packed-varlen attention: ``qkv (total, 3, h, d)`` -> ``(total, h, d)``.
+
+    `cu_seqlens` is the (b+1,) int32 prefix-sum of sequence lengths and
+    `max_s` the static padding length (reference fmha.py:33-56 takes the
+    same triple). No 512-token ceiling.
+    """
+    total, three, h, d = qkv.shape
+    assert three == 3, qkv.shape
+    b = cu_seqlens.shape[0] - 1
+    seq_id, offset = _unpack_ids(cu_seqlens, total, max_s)
+
+    # scatter packed tokens into the padded (b, max_s, 3, h, d) batch
+    padded = jnp.zeros((b, max_s, 3, h, d), qkv.dtype)
+    padded = padded.at[seq_id, offset].set(qkv)
+    q = padded[:, :, 0].transpose(0, 2, 1, 3).reshape(b * h, max_s, d)
+    k = padded[:, :, 1].transpose(0, 2, 1, 3).reshape(b * h, max_s, d)
+    v = padded[:, :, 2].transpose(0, 2, 1, 3).reshape(b * h, max_s, d)
+
+    lengths = cu_seqlens[1:] - cu_seqlens[:-1]  # (b,)
+    valid = jnp.arange(max_s)[None, :] < lengths[:, None]  # (b, max_s)
+    bias = jnp.where(valid[:, None, :], 0.0, -1e30).astype(jnp.float32)
+    bias = jnp.broadcast_to(bias, (b, max_s, max_s))
+
+    ctx = flash_attention(q, k, v, bias, causal, scale)
+    ctx = ctx.reshape(b, h, max_s, d).transpose(0, 2, 1, 3)  # (b, s, h, d)
+    return ctx[seq_id, offset]
+
+
+class FMHA(nn.Module):
+    """Module facade (reference fmha.py:60-118): packed qkv in, context
+    out, with the projection layers owned by the caller."""
+
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, qkv, cu_seqlens, max_s):
+        return fmha(qkv, cu_seqlens, max_s, causal=self.causal)

@@ -1,0 +1,19 @@
+"""Fused multi-head attention modules.
+
+Reference: apex/contrib/multihead_attn/ (SelfMultiheadAttn,
+EncdecMultiheadAttn, fast_mask_softmax_dropout_func) — fully fused
+QKV GEMMs + softmax + dropout + out-proj, with bias / additive-mask /
+"norm_add" (fused residual + LayerNorm) variants.
+"""
+
+from rocm_apex_tpu.contrib.multihead_attn.attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+    fast_mask_softmax_dropout,
+)
+
+__all__ = [
+    "SelfMultiheadAttn",
+    "EncdecMultiheadAttn",
+    "fast_mask_softmax_dropout",
+]

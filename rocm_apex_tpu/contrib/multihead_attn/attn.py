@@ -1,0 +1,208 @@
+"""Self / encoder-decoder multi-head attention, flash-cored.
+
+Rebuild of the reference's fused MHA family
+(reference: apex/contrib/multihead_attn/self_multihead_attn.py:27,
+encdec_multihead_attn.py, fast_self_multihead_attn_func.py:243): one
+fused input projection (QKV for self, Q + packed KV for encdec), the
+attention core, and the output projection, with the reference's three
+option axes:
+
+* ``bias``       — projection biases on/off;
+* ``mask``       — key-padding mask and/or additive attention mask;
+* ``include_norm_add`` — the "norm_add" variant: pre-LayerNorm on the
+  input and a residual add of the ORIGINAL input to the output
+  (reference self_multihead_attn.py lyr_norm + residual semantics).
+
+The core is the Pallas flash kernel when dropout is off (or eval);
+with attention dropout in training it falls back to the materialized
+scores path so the dropout pattern matches the stock implementation.
+Layout is batch-first ``(b, s, h)`` — the reference uses ``(s, b, h)``
+for CUDA-contiguity reasons that do not apply on TPU.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocm_apex_tpu.normalization import FusedLayerNorm
+from rocm_apex_tpu.ops.flash_attention import flash_attention
+
+__all__ = [
+    "SelfMultiheadAttn",
+    "EncdecMultiheadAttn",
+    "fast_mask_softmax_dropout",
+]
+
+
+def fast_mask_softmax_dropout(
+    scores: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    dropout_rate: float,
+    deterministic: bool,
+    rng=None,
+    scale: float = 1.0,
+):
+    """Standalone masked-softmax(+dropout) on materialized scores
+    (reference: fast_mask_softmax_dropout_func.py). ``mask`` True =
+    masked."""
+    s = scores.astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, -1e30, s)
+    p = jax.nn.softmax(s, axis=-1).astype(scores.dtype)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    return p
+
+
+def _attend(q, k, v, bias, heads, dropout, deterministic, dropout_rng):
+    """(b, s, h*d) projected operands -> (b, s, h*d) context."""
+    b, sq, hd_all = q.shape
+    sk = k.shape[1]
+    d = hd_all // heads
+    scale = 1.0 / np.sqrt(d)
+    use_flash = dropout == 0.0 or deterministic
+    qh = q.reshape(b, sq, heads, d).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+    if use_flash:
+        ctx = flash_attention(
+            qh.reshape(b * heads, sq, d),
+            kh.reshape(b * heads, sk, d),
+            vh.reshape(b * heads, sk, d),
+            bias,
+            False,
+            scale,
+        ).reshape(b, heads, sq, d)
+    else:
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+        ) * scale
+        if bias is not None:
+            nb = bias.shape[0]
+            s = s + bias.reshape(nb, -1, sq, sk).astype(jnp.float32)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), 0.0).astype(q.dtype)
+        ctx = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vh, preferred_element_type=q.dtype
+        )
+    return ctx.transpose(0, 2, 1, 3).reshape(b, sq, hd_all)
+
+
+def _combine_masks(b, sq, sk, key_padding_mask, attn_mask):
+    """-> additive (b, sq, sk) bias or None. key_padding_mask (b, sk)
+    True = pad; attn_mask additive (sq, sk) or bool (True = masked)."""
+    bias = None
+    if key_padding_mask is not None:
+        bias = jnp.where(
+            key_padding_mask[:, None, :], -1e30, 0.0
+        ).astype(jnp.float32)
+        bias = jnp.broadcast_to(bias, (b, sq, sk))
+    if attn_mask is not None:
+        am = attn_mask
+        if am.dtype == jnp.bool_:
+            am = jnp.where(am, -1e30, 0.0)
+        am = jnp.broadcast_to(am.astype(jnp.float32), (sq, sk))[None]
+        bias = am if bias is None else bias + am
+    return bias
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Reference: apex/contrib/multihead_attn/self_multihead_attn.py:27."""
+
+    num_heads: int
+    hidden_size: Optional[int] = None  # inferred from input when None
+    dropout: float = 0.0
+    bias: bool = True
+    include_norm_add: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        query: jnp.ndarray,
+        key_padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        h = self.hidden_size or query.shape[-1]
+        if h % self.num_heads:
+            raise ValueError(f"hidden {h} not divisible by {self.num_heads}")
+        residual = query
+        if self.include_norm_add:
+            query = FusedLayerNorm(h, name="lyr_norm")(query)
+        qkv = nn.Dense(
+            3 * h, use_bias=self.bias, dtype=self.dtype, name="qkv_proj"
+        )(query)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, sq, _ = q.shape
+        bias = _combine_masks(b, sq, sq, key_padding_mask, attn_mask)
+        rng = (
+            self.make_rng("dropout")
+            if (self.dropout > 0.0 and not deterministic)
+            else None
+        )
+        ctx = _attend(
+            q, k, v, bias, self.num_heads, self.dropout, deterministic, rng
+        )
+        out = nn.Dense(
+            h, use_bias=self.bias, dtype=self.dtype, name="out_proj"
+        )(ctx)
+        if self.include_norm_add:
+            out = out + residual
+        return out
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Reference: apex/contrib/multihead_attn/encdec_multihead_attn.py."""
+
+    num_heads: int
+    hidden_size: Optional[int] = None
+    dropout: float = 0.0
+    bias: bool = True
+    include_norm_add: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        query: jnp.ndarray,
+        key: jnp.ndarray,
+        key_padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        h = self.hidden_size or query.shape[-1]
+        if h % self.num_heads:
+            raise ValueError(f"hidden {h} not divisible by {self.num_heads}")
+        residual = query
+        if self.include_norm_add:
+            query = FusedLayerNorm(h, name="lyr_norm")(query)
+        q = nn.Dense(
+            h, use_bias=self.bias, dtype=self.dtype, name="q_proj"
+        )(query)
+        kv = nn.Dense(
+            2 * h, use_bias=self.bias, dtype=self.dtype, name="kv_proj"
+        )(key)
+        k, v = jnp.split(kv, 2, axis=-1)
+        b, sq, _ = q.shape
+        sk = k.shape[1]
+        bias = _combine_masks(b, sq, sk, key_padding_mask, attn_mask)
+        rng = (
+            self.make_rng("dropout")
+            if (self.dropout > 0.0 and not deterministic)
+            else None
+        )
+        ctx = _attend(
+            q, k, v, bias, self.num_heads, self.dropout, deterministic, rng
+        )
+        out = nn.Dense(
+            h, use_bias=self.bias, dtype=self.dtype, name="out_proj"
+        )(ctx)
+        if self.include_norm_add:
+            out = out + residual
+        return out

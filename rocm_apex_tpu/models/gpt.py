@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from rocm_apex_tpu.normalization import MixedFusedLayerNorm
+from rocm_apex_tpu.ops.flash_attention import flash_attention
+from rocm_apex_tpu.ops.xentropy import softmax_cross_entropy_loss
 from rocm_apex_tpu.ops.softmax import (
     scaled_masked_softmax,
     scaled_upper_triang_masked_softmax,
@@ -79,6 +81,12 @@ class GPTConfig:
     tensor_axis: str = parallel_state.TENSOR_AXIS
     init_method_std: float = 0.02
     use_pallas_softmax: bool = True
+    # "flash" (Pallas flash attention, no seqlen ceiling — the perf
+    # path), "fused_softmax" (materialized scores + Pallas softmax,
+    # reference csrc/megatron semantics), "jnp" (plain XLA fallback).
+    # flash has no in-kernel dropout: with attention_dropout > 0 in
+    # training mode the fused_softmax path is used instead.
+    attention_impl: str = "flash"
 
     @property
     def ffn_size(self) -> int:
@@ -177,43 +185,72 @@ class ParallelAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
 
         scale = 1.0 / np.sqrt(hd)
-        scores = jnp.einsum(
-            "bqnd,bknd->bnqk", q, k, preferred_element_type=jnp.float32
+        use_flash = cfg.attention_impl == "flash" and (
+            cfg.attention_dropout == 0.0 or deterministic
         )
-        if self.attn_mask_type == "causal":
-            if cfg.use_pallas_softmax:
-                probs = scaled_upper_triang_masked_softmax(
-                    scores.reshape(b * nh_local, sq, sq), scale
-                ).reshape(b, nh_local, sq, sq)
+        use_pallas_softmax = (
+            cfg.use_pallas_softmax and cfg.attention_impl != "jnp"
+        )
+        if use_flash:
+            qf = q.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
+            kf = k.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
+            vf = v.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
+            if self.attn_mask_type == "causal":
+                ctxf = flash_attention(qf, kf, vf, None, True, scale)
             else:
-                mask = ~jnp.tril(jnp.ones((sq, sq), bool))
-                s = jnp.where(mask, -jnp.inf, scores * scale)
-                probs = jax.nn.softmax(s, axis=-1)
+                if attention_mask is None:
+                    raise ValueError("padding attention needs attention_mask")
+                # broadcastable (b|1, 1, sq|1, sk) True = masked ->
+                # additive (b, sq, sk)
+                fb = jnp.where(
+                    jnp.broadcast_to(attention_mask, (b, 1, sq, sq)),
+                    -1e30,
+                    0.0,
+                ).astype(jnp.float32)[:, 0]
+                ctxf = flash_attention(qf, kf, vf, fb, False, scale)
+            ctx = (
+                ctxf.reshape(b, nh_local, sq, hd)
+                .transpose(0, 2, 1, 3)
+                .reshape(b, sq, nh_local * hd)
+            )
         else:
-            if attention_mask is None:
-                raise ValueError("padding attention needs attention_mask")
-            mask = jnp.broadcast_to(
-                attention_mask, (b, 1, sq, scores.shape[-1])
+            scores = jnp.einsum(
+                "bqnd,bknd->bnqk", q, k, preferred_element_type=jnp.float32
             )
-            if cfg.use_pallas_softmax:
-                probs = scaled_masked_softmax(scores, mask, scale)
+            if self.attn_mask_type == "causal":
+                if use_pallas_softmax:
+                    probs = scaled_upper_triang_masked_softmax(
+                        scores.reshape(b * nh_local, sq, sq), scale
+                    ).reshape(b, nh_local, sq, sq)
+                else:
+                    mask = ~jnp.tril(jnp.ones((sq, sq), bool))
+                    s = jnp.where(mask, -jnp.inf, scores * scale)
+                    probs = jax.nn.softmax(s, axis=-1)
             else:
-                s = jnp.where(mask, -jnp.inf, scores * scale)
-                probs = jax.nn.softmax(s, axis=-1)
-        probs = probs.astype(cfg.dtype)
+                if attention_mask is None:
+                    raise ValueError("padding attention needs attention_mask")
+                mask = jnp.broadcast_to(
+                    attention_mask, (b, 1, sq, scores.shape[-1])
+                )
+                if use_pallas_softmax:
+                    probs = scaled_masked_softmax(scores, mask, scale)
+                else:
+                    s = jnp.where(mask, -jnp.inf, scores * scale)
+                    probs = jax.nn.softmax(s, axis=-1)
+            probs = probs.astype(cfg.dtype)
 
-        if cfg.attention_dropout > 0.0:
-            # The reference forks the model-parallel RNG for attention
-            # dropout (get_cuda_rng_tracker().fork(), standalone_gpt.py);
-            # flax's named RNG + TP-rank folding is the equivalent.
-            probs = nn.Dropout(rate=cfg.attention_dropout)(
-                probs, deterministic=deterministic
+            if cfg.attention_dropout > 0.0:
+                # The reference forks the model-parallel RNG for attention
+                # dropout (get_cuda_rng_tracker().fork(), standalone_gpt.py);
+                # flax's named RNG + TP-rank folding is the equivalent.
+                probs = nn.Dropout(rate=cfg.attention_dropout)(
+                    probs, deterministic=deterministic
+                )
+
+            ctx = jnp.einsum(
+                "bnqk,bknd->bqnd", probs, v, preferred_element_type=cfg.dtype
             )
-
-        ctx = jnp.einsum(
-            "bnqk,bknd->bqnd", probs, v, preferred_element_type=cfg.dtype
-        )
-        ctx = ctx.reshape(b, sq, nh_local * hd)
+            ctx = ctx.reshape(b, sq, nh_local * hd)
         y, _ = RowParallelLinear(
             cfg.hidden_size,
             cfg.hidden_size,
@@ -386,8 +423,14 @@ class GPTModel(nn.Module):
 
 
 def _serial_cross_entropy(logits, labels):
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    """Fused Pallas CE on the (b*s, vocab) view — avoids materializing
+    fp32 logits + log-softmax over the vocabulary (the dominant
+    non-matmul cost of the LM head)."""
+    b, s, v = logits.shape
+    losses = softmax_cross_entropy_loss(
+        logits.reshape(b * s, v), labels.reshape(b * s), 0.0, None
+    )
+    return losses.reshape(b, s)
 
 
 def gpt_loss_fn(losses, loss_mask=None):

@@ -88,7 +88,7 @@ def _call(kernel, bufs: Sequence, cols: Sequence, scalars, out_dtypes: Sequence)
 # ---------------------------------------------------------------------------
 
 
-def _adam_kernel(adam_w_mode, p_ref, g_ref, m_ref, v_ref, wd_ref, s_ref, d_ref, m_out, v_out):
+def _adam_kernel(adam_w_mode, has_skip, p_ref, g_ref, m_ref, v_ref, wd_ref, s_ref, d_ref, m_out, v_out):
     lr, b1, b2, eps, bc1, bc2, gs = (s_ref[0, i] for i in range(7))
     p = p_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32) * gs
@@ -100,7 +100,18 @@ def _adam_kernel(adam_w_mode, p_ref, g_ref, m_ref, v_ref, wd_ref, s_ref, d_ref, 
     update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
     if adam_w_mode:  # decoupled decay (AdamW)
         update = update + wd * p
-    d_ref[...] = -lr * update
+    d = -lr * update
+    if has_skip:
+        # loss-scale skip folded into the buffer writes: no extra
+        # post-update select pass over the whole state (the jit-safe
+        # analogue of the reference's step no-op patch, handle.py:128-154).
+        # jnp.where, not an arithmetic blend — skipped steps carry
+        # inf/nan and inf * 0.0 == nan would poison the buffers.
+        on = s_ref[0, 7] < 0.5
+        d = jnp.where(on, d, 0.0)
+        m = jnp.where(on, m, m_ref[...])
+        v = jnp.where(on, v, v_ref[...])
+    d_ref[...] = d
     m_out[...] = m
     v_out[...] = v
 
@@ -112,9 +123,11 @@ def adam_update(p, g, m, v, wd_col, scalars, adam_w_mode: bool) -> Tuple:
     MODE_0 = L2 (decay into grad), MODE_1 = AdamW (decoupled), fp32 math,
     bias corrections bc1/bc2 precomputed by the caller (1 - beta^t, or 1
     with bias_correction off — reference fused_adam.py:117-147).
+    `scalars` is [lr, beta1, beta2, eps, bc1, bc2, grad_scale] plus an
+    optional 8th skip flag (1.0 = freeze the buffers, delta = 0).
     Returns (delta_p_f32, new_m, new_v).
     """
-    kern = functools.partial(_adam_kernel, adam_w_mode)
+    kern = functools.partial(_adam_kernel, adam_w_mode, len(scalars) > 7)
     return _call(
         kern, [p, g, m, v], [wd_col], scalars, [jnp.float32, m.dtype, v.dtype]
     )

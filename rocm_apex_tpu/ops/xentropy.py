@@ -89,22 +89,27 @@ def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0):
     API of `SoftmaxCrossEntropyLoss.apply`
     (reference: apex/contrib/xentropy/softmax_xentropy.py:4-28); returns
     fp32 losses (the reference's `half_to_float=True` behavior, which is
-    the only sensible mode on TPU).
+    the only sensible mode on TPU). ``padding_idx=None`` disables the
+    padded-label zeroing (every label contributes).
     """
     loss, _ = _fwd_impl(logits, labels, smoothing)
+    if padding_idx is None:
+        return loss
     return jnp.where(labels == padding_idx, 0.0, loss)
 
 
 def _vjp_fwd(logits, labels, smoothing, padding_idx):
     loss, lse = _fwd_impl(logits, labels, smoothing)
-    loss = jnp.where(labels == padding_idx, 0.0, loss)
+    if padding_idx is not None:
+        loss = jnp.where(labels == padding_idx, 0.0, loss)
     return loss, (logits, labels, lse)
 
 
 def _vjp_bwd(smoothing, padding_idx, res, dloss):
     logits, labels, lse = res
     rows0, vocab = logits.shape
-    dloss = jnp.where(labels == padding_idx, 0.0, dloss)
+    if padding_idx is not None:
+        dloss = jnp.where(labels == padding_idx, 0.0, dloss)
     block = _block_rows(vocab)
     xp = _pad_rows(logits, block)
     lbl = _pad_rows(labels.astype(jnp.int32).reshape(-1, 1), block)

@@ -362,3 +362,31 @@ class TestAmpIntegration:
         p2 = optax.apply_updates(params, updates)
         assert p2["w"].dtype == jnp.bfloat16
         assert float(jnp.abs(p2["w"].astype(jnp.float32) - params["w"].astype(jnp.float32)).max()) > 0
+
+
+class TestAdamKernelSkipFlag:
+    def test_eighth_scalar_freezes_buffers(self):
+        """The in-kernel skip flag (8th scalar) must zero the delta and
+        pass moments through even when grads are inf (inf*0 trap)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from rocm_apex_tpu.ops import optim_kernels
+        from rocm_apex_tpu.ops.packing import WIDTH
+
+        rows = optim_kernels.BLOCK_ROWS
+        p = jnp.ones((rows, WIDTH))
+        g = jnp.full((rows, WIDTH), jnp.inf)
+        m = jnp.ones((rows, WIDTH)) * 0.5
+        v = jnp.ones((rows, WIDTH)) * 0.25
+        wd = jnp.zeros((rows, 1))
+        scalars = [1e-2, 0.9, 0.999, 1e-8, 0.1, 0.001, 1.0, 1.0]  # skip=1
+        d, m2, v2 = optim_kernels.adam_update(p, g, m, v, wd, scalars, True)
+        np.testing.assert_array_equal(np.asarray(d), 0.0)
+        np.testing.assert_array_equal(np.asarray(m2), np.asarray(m))
+        np.testing.assert_array_equal(np.asarray(v2), np.asarray(v))
+        # skip=0 with finite grads still updates
+        g_ok = jnp.ones((rows, WIDTH))
+        scalars[-1] = 0.0
+        d, m2, v2 = optim_kernels.adam_update(p, g_ok, m, v, wd, scalars, True)
+        assert float(jnp.abs(d).max()) > 0.0
