@@ -1,0 +1,15 @@
+"""Fused dense layers (reference: apex/fused_dense/)."""
+
+from rocm_apex_tpu.fused_dense.fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+    fused_dense_function,
+    fused_dense_gelu_dense_function,
+)
+
+__all__ = [
+    "FusedDense",
+    "FusedDenseGeluDense",
+    "fused_dense_function",
+    "fused_dense_gelu_dense_function",
+]

@@ -1,0 +1,5 @@
+"""Fused MLP (reference: apex/mlp/)."""
+
+from rocm_apex_tpu.mlp.mlp import MLP, mlp  # noqa: F401
+
+__all__ = ["MLP", "mlp"]

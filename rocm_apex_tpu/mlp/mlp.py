@@ -1,0 +1,100 @@
+"""Fused multi-layer perceptron.
+
+Rebuild of the reference MLP (reference: apex/mlp/mlp.py:8-80 MlpFunction
+/ MLP; kernels csrc/mlp.cpp:46-164 + csrc/mlp_cuda.cu — cuBLAS GEMMs
+with fused bias+ReLU/sigmoid epilogue kernels, and a cuBLASLt path).
+
+On TPU the fusion the reference hand-rolls is exactly what XLA's
+dot+elementwise fusion emits from a straight-line chain of
+``dot → +bias → activation`` ops: one MXU pass per layer with the
+epilogue folded in, no intermediate HBM round-trips. The module layer
+therefore holds only the reference's API (layer sizing, bias flag,
+'none' | 'relu' | 'sigmoid' activations, matching init scheme
+mlp.py:63-71), and the compute is a plain jax function `mlp` so
+`jax.grad` produces the fused backward chain the reference implements
+by hand (mlp_cuda.cu bprop).
+"""
+
+from typing import List, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MLP", "mlp"]
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp(x, weights: Sequence, biases: Optional[Sequence], activation="relu"):
+    """Functional MLP chain: x @ W_i^T (+ b_i) -> act, per layer.
+
+    Weight layout is (out, in) like the reference
+    (apex/mlp/mlp.py:51-56); the final layer also gets the activation
+    (matching mlp_cuda.cu, which applies the epilogue on every layer).
+    """
+    if activation not in _ACTIVATIONS:
+        raise TypeError("activation must be none, relu or sigmoid")
+    act = _ACTIVATIONS[activation]
+    for i, w in enumerate(weights):
+        x = jnp.dot(x, w.T, preferred_element_type=x.dtype)
+        if biases is not None:
+            x = x + biases[i]
+        x = act(x)
+    return x
+
+
+class MLP(nn.Module):
+    """Module facade with the reference constructor
+    (reference: apex/mlp/mlp.py:26-48): ``mlp_sizes`` like
+    [in, h1, h2, ...] creates len-1 layers; init matches
+    reset_parameters (normal with std sqrt(2/(fan_in+fan_out)) for
+    weights, sqrt(1/out) for biases, mlp.py:63-71).
+    """
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.activation not in _ACTIVATIONS:
+            raise TypeError("activation must be none, relu or sigmoid")
+        sizes = list(self.mlp_sizes)
+        weights: List[jnp.ndarray] = []
+        biases: List[jnp.ndarray] = []
+        for i in range(len(sizes) - 1):
+            fan_in, fan_out = sizes[i], sizes[i + 1]
+            w_std = np.sqrt(2.0 / (fan_in + fan_out))
+            weights.append(
+                self.param(
+                    f"weight_{i}",
+                    nn.initializers.normal(stddev=w_std),
+                    (fan_out, fan_in),
+                    self.param_dtype,
+                )
+            )
+            if self.bias:
+                b_std = np.sqrt(1.0 / fan_out)
+                biases.append(
+                    self.param(
+                        f"bias_{i}",
+                        nn.initializers.normal(stddev=b_std),
+                        (fan_out,),
+                        self.param_dtype,
+                    )
+                )
+        x = x.astype(self.dtype)
+        return mlp(
+            x,
+            [w.astype(self.dtype) for w in weights],
+            [b.astype(self.dtype) for b in biases] if self.bias else None,
+            self.activation,
+        )

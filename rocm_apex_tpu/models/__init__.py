@@ -20,8 +20,23 @@ from rocm_apex_tpu.models.gpt import (  # noqa: F401
     gpt_loss_fn,
 )
 from rocm_apex_tpu.models.bert import BertConfig, BertModel  # noqa: F401
+from rocm_apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401
+from rocm_apex_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+)
 
 __all__ = [
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "Generator",
+    "Discriminator",
     "GPTConfig",
     "GPTModel",
     "ParallelMLP",

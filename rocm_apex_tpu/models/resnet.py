@@ -1,0 +1,153 @@
+"""ResNet family, TPU-native (NHWC), with SyncBatchNorm option.
+
+The reference's north-star example trains torchvision ResNet-50 under
+amp + DDP (reference: examples/imagenet/main_amp.py; the L1 harness
+runs b=128 RN50, tests/L1/common/run_test.sh:20-27). This is that model
+as flax modules: NHWC layout (TPU conv-native; the reference reaches
+the same layout via --channels-last), `nn.BatchNorm` by default or the
+framework's cross-replica `SyncBatchNorm` when `sync_bn_axis` is set
+(reference: apex.parallel.SyncBatchNorm + convert_syncbn_model).
+"""
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from rocm_apex_tpu.parallel import SyncBatchNorm
+
+__all__ = [
+    "ResNet",
+    "BasicBlock",
+    "Bottleneck",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+]
+
+
+def _norm(cfg_axis, dtype):
+    if cfg_axis is not None:
+        return functools.partial(
+            SyncBatchNorm,
+            momentum=0.1,
+            axis_name=cfg_axis,
+            channel_last=True,
+            dtype=dtype,
+        )
+    return functools.partial(
+        nn.BatchNorm, momentum=0.9, epsilon=1e-5, dtype=dtype
+    )
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: Any = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.Conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            padding=1, use_bias=False, dtype=self.dtype, name="conv1",
+        )(x)
+        y = self.norm(name="bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.filters, (3, 3), padding=1, use_bias=False,
+            dtype=self.dtype, name="conv2",
+        )(y)
+        y = self.norm(name="bn2")(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), (self.strides, self.strides),
+                use_bias=False, dtype=self.dtype, name="downsample_conv",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(
+                residual, use_running_average=not train
+            )
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: Any = None
+    dtype: jnp.dtype = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.Conv(
+            self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+            name="conv1",
+        )(x)
+        y = self.norm(name="bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.filters, (3, 3), (self.strides, self.strides), padding=1,
+            use_bias=False, dtype=self.dtype, name="conv2",
+        )(y)
+        y = self.norm(name="bn2")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.filters * self.expansion, (1, 1), use_bias=False,
+            dtype=self.dtype, name="conv3",
+        )(y)
+        y = self.norm(name="bn3")(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * self.expansion, (1, 1),
+                (self.strides, self.strides), use_bias=False,
+                dtype=self.dtype, name="downsample_conv",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(
+                residual, use_running_average=not train
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet. `sync_bn_axis` switches BN to cross-replica stats."""
+
+    stage_sizes: Sequence[int]
+    block: Any = Bottleneck
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.float32
+    sync_bn_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = _norm(self.sync_bn_axis, self.dtype)
+        x = nn.Conv(
+            self.num_filters, (7, 7), (2, 2), padding=3, use_bias=False,
+            dtype=self.dtype, name="conv1",
+        )(x)
+        x = norm(name="bn1")(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(
+                    self.num_filters * 2**i,
+                    strides=strides,
+                    norm=norm,
+                    dtype=self.dtype,
+                    name=f"layer{i + 1}_{j}",
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x
+
+
+resnet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+resnet34 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
+resnet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block=Bottleneck)
+resnet101 = functools.partial(ResNet, stage_sizes=(3, 4, 23, 3), block=Bottleneck)
