@@ -1,0 +1,14 @@
+"""Fused ResNet bottleneck + spatial-parallel variant.
+
+Reference: apex/contrib/bottleneck/bottleneck.py:112-512 (cudnn-frontend
+fused conv-bn-relu `Bottleneck`, and `SpatialBottleneck` with explicit
+halo exchange across spatially-partitioned ranks).
+"""
+
+from rocm_apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
+    Bottleneck,
+    SpatialBottleneck,
+    halo_exchange,
+)
+
+__all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange"]

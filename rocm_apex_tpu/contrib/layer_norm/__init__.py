@@ -1,0 +1,50 @@
+"""Fast LayerNorm — the contrib high-perf LN restricted to 2-D views.
+
+Reference: apex/contrib/layer_norm/layer_norm.py:8-80 (`FastLayerNormFN`
+returning (y, mu, rsigma), `FastLayerNorm` module; kernels
+apex/contrib/csrc/layer_norm/). The row-tiled Pallas kernel in
+ops/layer_norm.py serves both this and apex.normalization; this package
+carries the contrib API shape.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from rocm_apex_tpu.ops.layer_norm import layer_norm_affine, layer_norm_fwd
+
+__all__ = ["FastLayerNorm", "fast_layer_norm"]
+
+
+def fast_layer_norm(x2d, weight, bias, eps: float = 1e-5):
+    """(rows, hidden) -> normalized (rows, hidden); the FastLayerNormFN
+    contract (reference layer_norm.py:8-38) with the fused backward."""
+    if x2d.ndim != 2:
+        raise ValueError(
+            f"fast_layer_norm operates on 2D (rows, hidden) views, got "
+            f"{x2d.shape}"
+        )
+    return layer_norm_affine(x2d, weight, bias, eps)
+
+
+class FastLayerNorm(nn.Module):
+    """Module facade (reference layer_norm.py:40-80)."""
+
+    hidden_size: int
+    eps: float = 1e-5
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param(
+            "weight", nn.initializers.ones_init(),
+            (self.hidden_size,), self.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(),
+            (self.hidden_size,), self.param_dtype,
+        )
+        shape = x.shape
+        y = fast_layer_norm(
+            x.reshape(-1, self.hidden_size), weight, bias, self.eps
+        )
+        return y.reshape(shape)

@@ -1,0 +1,20 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Reference: apex/contrib/sparsity/asp.py:21-217 + sparse_masklib.py.
+"""
+
+from rocm_apex_tpu.contrib.sparsity.asp import (  # noqa: F401
+    ASP,
+    apply_masks,
+    compute_sparse_masks,
+    create_mask,
+    maintain_sparsity,
+)
+
+__all__ = [
+    "ASP",
+    "compute_sparse_masks",
+    "apply_masks",
+    "create_mask",
+    "maintain_sparsity",
+]

@@ -1,0 +1,137 @@
+"""2:4 structured sparsity (ASP), functional.
+
+Rebuild of the reference ASP
+(reference: apex/contrib/sparsity/asp.py:21-217 — `init_model_for_pruning`
+/ `init_optimizer_for_pruning` monkey-patch `optimizer.step` to re-apply
+the masks after every update; masks from sparse_masklib.py `m4n2_1d`,
+best 2-of-4 magnitudes per group). Functionally:
+
+    masks  = compute_sparse_masks(params, is_prunable)
+    params = apply_masks(params, masks)
+    tx     = optax.chain(inner_tx, maintain_sparsity(masks))
+
+`maintain_sparsity` is the optax analogue of the step patch: it masks
+the updates so pruned weights receive zero deltas and therefore stay
+zero — checkpoint-aware for free (masks are derivable from the zeros).
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = [
+    "create_mask",
+    "compute_sparse_masks",
+    "apply_masks",
+    "maintain_sparsity",
+    "ASP",
+]
+
+
+def create_mask(weight: jnp.ndarray, pattern: str = "m4n2_1d") -> jnp.ndarray:
+    """Bool keep-mask with the reference's m4n2 pattern: within every
+    group of 4 consecutive elements along the last dim, keep the 2
+    largest magnitudes (reference: sparse_masklib.py m4n2_1d)."""
+    if pattern != "m4n2_1d":
+        raise ValueError(f"unsupported pattern {pattern!r}")
+    if weight.shape[-1] % 4:
+        raise ValueError(
+            f"last dim {weight.shape[-1]} not divisible by the group size 4"
+        )
+    g = jnp.abs(weight).reshape(*weight.shape[:-1], -1, 4)
+    # rank within each group; keep the top 2
+    order = jnp.argsort(g, axis=-1)  # ascending
+    rank = jnp.argsort(order, axis=-1)
+    keep = rank >= 2
+    return keep.reshape(weight.shape)
+
+
+def _default_prunable(path, leaf) -> bool:
+    """The reference prunes >=2D weights of linear/conv modules with
+    both dims >= 16 (asp.py whitelist + size guard)."""
+    return (
+        hasattr(leaf, "ndim")
+        and leaf.ndim >= 2
+        and leaf.shape[-1] % 4 == 0
+        and min(leaf.shape[-1], leaf.shape[-2]) >= 16
+    )
+
+
+def compute_sparse_masks(
+    params: Any,
+    is_prunable: Optional[Callable] = None,
+    pattern: str = "m4n2_1d",
+) -> Any:
+    """Mask pytree: bool keep-mask for prunable leaves, None elsewhere
+    (reference: ASP.compute_sparse_masks, asp.py:21-150)."""
+    pred = is_prunable or _default_prunable
+
+    def one(path, leaf):
+        return create_mask(leaf, pattern) if pred(path, leaf) else None
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def apply_masks(params: Any, masks: Any) -> Any:
+    """Zero out pruned weights."""
+    return jax.tree_util.tree_map(
+        lambda p, m: p if m is None else jnp.where(m, p, 0).astype(p.dtype),
+        params,
+        masks,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def maintain_sparsity(masks: Any) -> optax.GradientTransformation:
+    """Optax transform masking updates so pruned weights stay pruned —
+    the functional analogue of the reference's optimizer.step patch
+    (asp.py init_optimizer_for_pruning)."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        masked = jax.tree_util.tree_map(
+            lambda u, m: u if m is None else jnp.where(m, u, 0).astype(u.dtype),
+            updates,
+            masks,
+            is_leaf=lambda x: x is None,
+        )
+        return masked, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class ASP:
+    """Stateful facade with the reference's entry points (asp.py:21):
+
+        asp = ASP()
+        params = asp.init_model_for_pruning(params)
+        tx = asp.init_optimizer_for_pruning(tx)
+    """
+
+    def __init__(
+        self,
+        mask_calculator: str = "m4n2_1d",
+        is_prunable: Optional[Callable] = None,
+    ):
+        self.pattern = mask_calculator
+        self.is_prunable = is_prunable
+        self.masks = None
+
+    def init_model_for_pruning(self, params):
+        self.masks = compute_sparse_masks(params, self.is_prunable, self.pattern)
+        return apply_masks(params, self.masks)
+
+    def init_optimizer_for_pruning(self, tx: optax.GradientTransformation):
+        if self.masks is None:
+            raise RuntimeError("call init_model_for_pruning first")
+        return optax.chain(tx, maintain_sparsity(self.masks))
+
+    def compute_sparse_masks(self, params):
+        self.masks = compute_sparse_masks(params, self.is_prunable, self.pattern)
+        return self.masks

@@ -1,0 +1,20 @@
+"""RNN-T transducer joint + loss.
+
+Reference: apex/contrib/transducer/transducer.py:5-195 (kernels
+apex/contrib/csrc/transducer/transducer_joint_kernel.cu:979,
+transducer_loss_kernel.cu:767).
+"""
+
+from rocm_apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+
+__all__ = [
+    "TransducerJoint",
+    "TransducerLoss",
+    "transducer_joint",
+    "transducer_loss",
+]

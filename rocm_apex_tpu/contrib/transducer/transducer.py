@@ -1,0 +1,209 @@
+"""Transducer (RNN-T) joint and loss, TPU-native.
+
+Rebuild of the reference transducer package
+(reference: apex/contrib/transducer/transducer.py — TransducerJoint:5,
+TransducerLoss:69; device code transducer_joint_kernel.cu:979 tiled
+f+g broadcast add, transducer_loss_kernel.cu:767 alpha/beta dynamic
+programming in-kernel).
+
+The joint is the broadcast add ``f (B,T,H) + g (B,U,H) -> (B,T,U,H)``
+with optional fused ReLU/dropout epilogue — pure XLA fusion territory.
+
+The loss runs the log-space alpha recursion
+
+    alpha[t,u] = logaddexp(alpha[t-1,u] + blank[t-1,u],
+                           alpha[t,u-1] + emit[t,u-1])
+
+as a `lax.scan` over T where each row's prefix recurrence over U is
+closed-form via `cumlogsumexp` (substituting b[u] = alpha[t,u] - E[u],
+E = prefix-sum of emit, turns the recurrence into a running
+log-sum-exp) — the scan-friendly alternative to the reference's
+per-cell wavefront kernel. The backward (the reference's fused
+softmax+loss backward) falls out of `jax.grad` through the scan.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "transducer_joint",
+    "transducer_loss",
+    "TransducerJoint",
+    "TransducerLoss",
+]
+
+_NEG = -1e30
+
+
+def transducer_joint(
+    f: jnp.ndarray,
+    g: jnp.ndarray,
+    f_len: jnp.ndarray,
+    g_len: jnp.ndarray,
+    *,
+    pack_output: bool = False,
+    relu: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
+    batch_offset: Optional[jnp.ndarray] = None,
+    packed_batch: int = 0,
+):
+    """f (B,T,H) + g (B,U,H) -> joint (B,T,U,H), or packed (total, H).
+
+    Mirrors `TransducerJoint.forward`
+    (reference transducer.py:43-67): `batch_offset` = cumsum(f_len*g_len)
+    and `packed_batch` (static total) are required when packing.
+    """
+    h = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        h = jax.nn.relu(h)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout needs dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+    if not pack_output:
+        return h
+    if batch_offset is None or packed_batch == 0:
+        raise ValueError(
+            "Please specify batch_offset and packed_batch when packing is "
+            "enabled"
+        )
+    B, T, U, H = h.shape
+    # packed row i of batch b sits at batch_offset[b-1] + t*g_len[b] + u
+    idx = jnp.arange(packed_batch)
+    start = jnp.concatenate([jnp.zeros((1,), batch_offset.dtype), batch_offset])
+    b = jnp.searchsorted(batch_offset, idx, side="right")
+    r = idx - start[b]
+    t = r // g_len[b]
+    u = r % g_len[b]
+    return h[b, t, u]
+
+
+def transducer_loss(
+    x: jnp.ndarray,
+    label: jnp.ndarray,
+    f_len: jnp.ndarray,
+    y_len: jnp.ndarray,
+    blank_idx: int,
+) -> jnp.ndarray:
+    """Per-batch RNN-T negative log-likelihood.
+
+    ``x`` (B, T, U, V) raw logits (log-softmax applied inside, matching
+    the reference's fused softmax+loss, transducer.py:69-117);
+    ``label`` (B, U-1) targets; ``f_len`` time lengths; ``y_len`` label
+    lengths (U dimension covers y_len+1 states).
+    """
+    B, T, U, V = x.shape
+    lp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+    blank = lp[..., blank_idx]  # (B, T, U)
+    # emit[b, t, u] = lp of label[b, u] at (t, u); u = y_len.. masked
+    lbl = jnp.minimum(label, V - 1)
+    emit = jnp.take_along_axis(
+        lp[:, :, : U - 1],
+        jnp.broadcast_to(lbl[:, None, :, None], (B, T, U - 1, 1)),
+        axis=3,
+    )[..., 0]
+    emit = jnp.concatenate([emit, jnp.full((B, T, 1), _NEG)], axis=2)
+    u_ids = jnp.arange(U)[None, :]
+    emit = jnp.where(u_ids[:, None, :] < y_len[:, None, None], emit, _NEG)
+
+    def row(alpha_prev, inputs):
+        # alpha_prev (B, U): alpha[t-1, :]; inputs: (blank[t-1], emit[t])
+        blank_prev, emit_row = inputs
+        a = alpha_prev + blank_prev  # (B, U)
+        # E[u] = sum_{j<u} emit_row[j]
+        E = jnp.concatenate(
+            [jnp.zeros((B, 1)), jnp.cumsum(emit_row[:, :-1], axis=1)], axis=1
+        )
+        b = jax.lax.cumlogsumexp(a - E, axis=1)
+        return E + b, None
+
+    # t = 0 row: alpha[0, u] = prefix sums of emit[0]
+    alpha0 = jnp.concatenate(
+        [jnp.zeros((B, 1)), jnp.cumsum(emit[:, 0, :-1], axis=1)], axis=1
+    )
+    # iterate t = 1..T-1; stack (blank[t-1], emit[t]) pairs
+    if T > 1:
+        xs = (
+            jnp.moveaxis(blank[:, :-1], 1, 0),  # (T-1, B, U)
+            jnp.moveaxis(emit[:, 1:], 1, 0),
+        )
+        def step(c, i):
+            a, _ = row(c, i)
+            return a, a
+
+        _, rows = jax.lax.scan(step, alpha0, xs)
+        alphas = jnp.concatenate([alpha0[None], rows], axis=0)  # (T, B, U)
+    else:
+        alphas = alpha0[None]
+    alphas = jnp.moveaxis(alphas, 0, 1)  # (B, T, U)
+
+    bi = jnp.arange(B)
+    t_last = jnp.clip(f_len - 1, 0, T - 1)
+    alpha_end = alphas[bi, t_last, y_len]
+    final_blank = blank[bi, t_last, y_len]
+    return -(alpha_end + final_blank)
+
+
+class TransducerJoint:
+    """Module facade (reference transducer.py:5-67). Stateless; the
+    CUDA tiling knobs (`opt`, `fwd_tile_size`) are accepted and ignored
+    (XLA tiles the broadcast add)."""
+
+    def __init__(
+        self,
+        pack_output: bool = False,
+        relu: bool = False,
+        dropout: bool = False,
+        opt: int = 1,
+        fwd_tile_size: int = 4,
+        dropout_prob: float = 0.0,
+        probe_mask: bool = False,
+    ):
+        if (relu or dropout) and opt != 1:
+            raise NotImplementedError(
+                "ReLU and dropout fusion is only supported with opt=1"
+            )
+        del fwd_tile_size, probe_mask
+        self.pack_output = pack_output
+        self.relu = relu
+        self.dropout = dropout
+        self.dropout_prob = dropout_prob
+
+    def __call__(
+        self, f, g, f_len, g_len, batch_offset=None, packed_batch=0,
+        dropout_rng=None,
+    ):
+        return transducer_joint(
+            f, g, f_len, g_len,
+            pack_output=self.pack_output,
+            relu=self.relu,
+            dropout_rate=self.dropout_prob if self.dropout else 0.0,
+            dropout_rng=dropout_rng,
+            batch_offset=batch_offset,
+            packed_batch=packed_batch,
+        )
+
+
+class TransducerLoss:
+    """Module facade (reference transducer.py:69-117)."""
+
+    def __init__(
+        self,
+        fuse_softmax_backward: bool = True,
+        opt: int = 1,
+        packed_input: bool = False,
+    ):
+        del fuse_softmax_backward, opt
+        if packed_input:
+            raise NotImplementedError(
+                "packed_input: unpack with transducer_joint(pack_output="
+                "False) on TPU — XLA's fusion makes the padded layout the "
+                "fast path"
+            )
+
+    def __call__(self, x, label, f_len, y_len, blank_idx):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

@@ -1,0 +1,34 @@
+"""Fused label-smoothing softmax cross-entropy.
+
+Reference: apex/contrib/xentropy/softmax_xentropy.py:4-28 (kernels
+apex/contrib/csrc/xentropy/xentropy_kernel.cu:726). The Pallas kernel
+lives in ops/xentropy.py; this package carries the reference's API.
+"""
+
+import jax.numpy as jnp
+
+from rocm_apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+
+class SoftmaxCrossEntropyLoss:
+    """Callable mirroring `SoftmaxCrossEntropyLoss.apply`
+    (reference: softmax_xentropy.py:4-28): per-row smoothed losses on
+    (rows, vocab) logits, labels == ``padding_idx`` produce zero loss
+    and zero grad. ``half_to_float`` is accepted for parity; losses are
+    always fp32 (the only sensible mode on TPU)."""
+
+    @staticmethod
+    def apply(
+        logits: jnp.ndarray,
+        labels: jnp.ndarray,
+        smoothing: float = 0.0,
+        padding_idx: int = 0,
+        half_to_float: bool = True,
+    ) -> jnp.ndarray:
+        del half_to_float
+        return softmax_cross_entropy_loss(logits, labels, smoothing, padding_idx)
+
+    def __call__(self, *args, **kw):
+        return self.apply(*args, **kw)
