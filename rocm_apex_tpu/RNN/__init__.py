@@ -1,0 +1,25 @@
+"""RNN stack (deprecated in the reference; kept for parity).
+
+Reference: apex/RNN/ — models.py:19-47 factories (RNN/LSTM/GRU/mLSTM),
+RNNBackend.py (bidirectionalRNN:25, stackedRNN:90, RNNCell:232),
+cells.py:84 (mLSTM). The reference marks the package deprecated; this
+rebuild expresses the recurrences as `lax.scan` (the XLA-friendly form)
+under the same factory API.
+"""
+
+from rocm_apex_tpu.RNN.models import GRU, LSTM, RNN, mLSTM  # noqa: F401
+from rocm_apex_tpu.RNN.backend import (  # noqa: F401
+    BidirectionalRNN,
+    RNNCellModule,
+    StackedRNN,
+)
+
+__all__ = [
+    "RNN",
+    "LSTM",
+    "GRU",
+    "mLSTM",
+    "StackedRNN",
+    "BidirectionalRNN",
+    "RNNCellModule",
+]

@@ -1,0 +1,175 @@
+"""RNN cells + stacked/bidirectional drivers over `lax.scan`.
+
+Reference: apex/RNN/RNNBackend.py — `RNNCell:232` (fused gate matmul
+per step), `stackedRNN:90` (layer stack with inter-layer dropout),
+`bidirectionalRNN:25` (fwd + reversed cells, concatenated outputs);
+mLSTM cell from apex/RNN/cells.py:84. The python-loop time dimension
+becomes `lax.scan` — the compiled, remat-friendly TPU form.
+
+Layout: (seq, batch, feature), matching the reference.
+"""
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RNNCellModule", "StackedRNN", "BidirectionalRNN", "CELLS"]
+
+
+def _rnn_relu(x, h, params):
+    new_h = jax.nn.relu(x @ params["w_ih"] + h[0] @ params["w_hh"] + params["b"])
+    return (new_h,), new_h
+
+
+def _rnn_tanh(x, h, params):
+    new_h = jnp.tanh(x @ params["w_ih"] + h[0] @ params["w_hh"] + params["b"])
+    return (new_h,), new_h
+
+
+def _lstm(x, h, params):
+    hx, cx = h
+    gates = x @ params["w_ih"] + hx @ params["w_hh"] + params["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    cy = f * cx + i * g
+    hy = o * jnp.tanh(cy)
+    return (hy, cy), hy
+
+
+def _gru(x, h, params):
+    hx = h[0]
+    ri = x @ params["w_ih"] + params["b"]
+    rh = hx @ params["w_hh"]
+    ir, iz, in_ = jnp.split(ri, 3, axis=-1)
+    hr, hz, hn = jnp.split(rh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    hy = (1.0 - z) * n + z * hx
+    return (hy,), hy
+
+
+def _mlstm(x, h, params):
+    """Multiplicative LSTM (reference cells.py:84): m = (x W_mx)*(h W_mh)
+    feeds the gate block in place of h."""
+    hx, cx = h
+    m = (x @ params["w_mx"]) * (hx @ params["w_mh"])
+    gates = x @ params["w_ih"] + m @ params["w_hh"] + params["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    cy = f * cx + i * g
+    hy = o * jnp.tanh(cy)
+    return (hy, cy), hy
+
+
+#         step fn,   gate multiple, n hidden states, extra params
+CELLS = {
+    "RNNReLU": (_rnn_relu, 1, 1, ()),
+    "RNNTanh": (_rnn_tanh, 1, 1, ()),
+    "LSTM": (_lstm, 4, 2, ()),
+    "GRU": (_gru, 3, 1, ()),
+    "mLSTM": (_mlstm, 4, 2, ("w_mx", "w_mh")),
+}
+
+
+class RNNCellModule(nn.Module):
+    """One recurrent layer scanned over time
+    (reference RNNBackend.py:232-303)."""
+
+    cell: str
+    hidden_size: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs, h0: Optional[Tuple] = None, reverse: bool = False):
+        step, mult, n_state, extras = CELLS[self.cell]
+        in_f = xs.shape[-1]
+        hs = self.hidden_size
+        params = {
+            "w_ih": self.param(
+                "w_ih", nn.initializers.lecun_normal(), (in_f, mult * hs),
+                self.dtype,
+            ),
+            "w_hh": self.param(
+                "w_hh", nn.initializers.orthogonal(), (hs, mult * hs),
+                self.dtype,
+            ),
+            "b": self.param(
+                "b", nn.initializers.zeros_init(), (mult * hs,), self.dtype
+            ),
+        }
+        for name in extras:
+            params[name] = self.param(
+                name, nn.initializers.lecun_normal(),
+                (in_f if name == "w_mx" else hs, hs), self.dtype,
+            )
+        b = xs.shape[1]
+        if h0 is None:
+            h0 = tuple(
+                jnp.zeros((b, hs), self.dtype) for _ in range(n_state)
+            )
+
+        def scan_step(carry, x):
+            new_carry, y = step(x, carry, params)
+            return new_carry, y
+
+        hN, ys = jax.lax.scan(scan_step, h0, xs, reverse=reverse)
+        return ys, hN
+
+
+class StackedRNN(nn.Module):
+    """Layer stack with inter-layer dropout
+    (reference RNNBackend.py:90-230)."""
+
+    cell: str
+    hidden_size: int
+    num_layers: int = 1
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs, deterministic: bool = True):
+        states = []
+        for i in range(self.num_layers):
+            xs, hN = RNNCellModule(
+                self.cell, self.hidden_size, self.dtype, name=f"layer_{i}"
+            )(xs)
+            states.append(hN)
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                xs = nn.Dropout(rate=self.dropout)(
+                    xs, deterministic=deterministic
+                )
+        return xs, states
+
+
+class BidirectionalRNN(nn.Module):
+    """Forward + reversed cells, outputs concatenated
+    (reference RNNBackend.py:25-88)."""
+
+    cell: str
+    hidden_size: int
+    num_layers: int = 1
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs, deterministic: bool = True):
+        states = []
+        for i in range(self.num_layers):
+            fwd, h_f = RNNCellModule(
+                self.cell, self.hidden_size, self.dtype, name=f"fwd_{i}"
+            )(xs)
+            bwd, h_b = RNNCellModule(
+                self.cell, self.hidden_size, self.dtype, name=f"bwd_{i}"
+            )(xs, reverse=True)
+            xs = jnp.concatenate([fwd, bwd], axis=-1)
+            states.append((h_f, h_b))
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                xs = nn.Dropout(rate=self.dropout)(
+                    xs, deterministic=deterministic
+                )
+        return xs, states

@@ -1,0 +1,42 @@
+"""RNN factories (reference: apex/RNN/models.py:19-47)."""
+
+from rocm_apex_tpu.RNN.backend import BidirectionalRNN, StackedRNN
+
+__all__ = ["RNN", "LSTM", "GRU", "mLSTM"]
+
+
+def _make(cell):
+    def factory(
+        input_size,
+        hidden_size,
+        num_layers=1,
+        bidirectional=False,
+        dropout=0.0,
+        **kw,
+    ):
+        del input_size  # inferred from the input (flax convention)
+        cls = BidirectionalRNN if bidirectional else StackedRNN
+        return cls(
+            cell=cell,
+            hidden_size=hidden_size,
+            num_layers=num_layers,
+            dropout=dropout,
+            **kw,
+        )
+
+    factory.__name__ = cell
+    return factory
+
+
+def RNN(input_size, hidden_size, num_layers=1, bidirectional=False,
+        dropout=0.0, nonlinearity="tanh", **kw):
+    """reference models.py:30-38 (nonlinearity picks the cell)."""
+    cell = {"tanh": "RNNTanh", "relu": "RNNReLU"}[nonlinearity]
+    return _make(cell)(
+        input_size, hidden_size, num_layers, bidirectional, dropout, **kw
+    )
+
+
+LSTM = _make("LSTM")
+GRU = _make("GRU")
+mLSTM = _make("mLSTM")

@@ -1,0 +1,35 @@
+"""Legacy manual mixed-precision helpers (fp16_utils).
+
+Reference: apex/fp16_utils/ — fp16util.py (network_to_half:35,
+convert_network:60, prep_param_lists:90, grad/master copies :136-175),
+fp16_optimizer.py (FP16_Optimizer:13), loss_scaler.py (LossScaler:10,
+DynamicLossScaler:47). The reference deprecates these in favor of amp
+(docs/source/fp16_utils.rst); here they are thin functional shims over
+the same machinery amp uses, kept for capability parity.
+"""
+
+from rocm_apex_tpu.fp16_utils.fp16util import (  # noqa: F401
+    BN_convert_float,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+)
+from rocm_apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+from rocm_apex_tpu.fp16_utils.loss_scaler import (  # noqa: F401
+    DynamicLossScaler,
+    LossScaler,
+)
+
+__all__ = [
+    "network_to_half",
+    "convert_network",
+    "BN_convert_float",
+    "prep_param_lists",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "FP16_Optimizer",
+    "LossScaler",
+    "DynamicLossScaler",
+]

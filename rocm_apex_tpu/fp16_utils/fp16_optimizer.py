@@ -1,0 +1,106 @@
+"""FP16_Optimizer: the legacy master-weight optimizer wrapper.
+
+Reference: apex/fp16_utils/fp16_optimizer.py:13-554 — wraps any
+optimizer with fp32 master weights, static/dynamic loss scaling, and
+overflow-skipped steps. Functional restatement over the modern pieces
+(the reference itself points users to amp):
+
+    opt = FP16_Optimizer(optax_tx, static_loss_scale=128.0)
+    state = opt.init(model_params_fp16)
+    ...
+    scaled_loss = opt.scale_loss(loss, state)         # backward on this
+    state = opt.step(state, grads_fp16)               # skips on overflow
+    model_params = state.model_params
+"""
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from rocm_apex_tpu.amp.scaler import LossScaler as _Scaler
+from rocm_apex_tpu.amp.scaler import ScalerState, all_finite
+from rocm_apex_tpu.optimizers._common import tree_where
+
+__all__ = ["FP16_Optimizer"]
+
+
+class FP16OptimizerState(NamedTuple):
+    model_params: Any   # low-precision tree
+    master_params: Any  # fp32 tree
+    inner_state: Any
+    scaler_state: ScalerState
+
+
+class FP16_Optimizer:
+    """Reference constructor vocabulary (fp16_optimizer.py:13-90):
+    exactly one of static_loss_scale / dynamic_loss_scale."""
+
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        dynamic_loss_args: Optional[dict] = None,
+        verbose: bool = False,
+    ):
+        self.tx = tx
+        if dynamic_loss_scale:
+            args = dynamic_loss_args or {}
+            self.scaler = _Scaler(
+                loss_scale="dynamic",
+                init_scale=args.get("init_scale", 2.0**32),
+                scale_factor=args.get("scale_factor", 2.0),
+                scale_window=args.get("scale_window", 1000),
+            )
+        else:
+            self.scaler = _Scaler(loss_scale=float(static_loss_scale))
+        self.verbose = verbose
+
+    def init(self, model_params: Any) -> FP16OptimizerState:
+        masters = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32), model_params
+        )
+        return FP16OptimizerState(
+            model_params=model_params,
+            master_params=masters,
+            inner_state=self.tx.init(masters),
+            scaler_state=self.scaler.init(),
+        )
+
+    def scale_loss(self, loss, state: FP16OptimizerState):
+        """The `backward(loss)` scaling half (reference
+        fp16_optimizer.py backward); differentiate the scaled loss."""
+        return self.scaler.scale(state.scaler_state, loss)
+
+    def step(self, state: FP16OptimizerState, grads: Any) -> FP16OptimizerState:
+        """Unscale, overflow-check, inner update on masters, cast-down
+        (reference fp16_optimizer.py step: skip on overflow)."""
+        grads, found_inf = self.scaler.unscale(state.scaler_state, grads)
+        new_scaler, skip = self.scaler.update(state.scaler_state, found_inf)
+        safe = jax.tree_util.tree_map(
+            lambda g: jnp.where(jnp.isfinite(g), g, 0.0), grads
+        )
+        updates, new_inner = self.tx.update(
+            safe, state.inner_state, state.master_params
+        )
+        new_masters = optax.apply_updates(state.master_params, updates)
+        new_masters = tree_where(skip, state.master_params, new_masters)
+        new_inner = tree_where(skip, state.inner_state, new_inner)
+        new_model = jax.tree_util.tree_map(
+            lambda mo, ma: ma.astype(mo.dtype),
+            state.model_params,
+            new_masters,
+        )
+        return FP16OptimizerState(
+            model_params=new_model,
+            master_params=new_masters,
+            inner_state=new_inner,
+            scaler_state=new_scaler,
+        )
+
+    # reference helpers
+    @staticmethod
+    def has_overflow(grads):
+        return ~all_finite(grads)

@@ -1,0 +1,73 @@
+"""Manual precision-conversion helpers.
+
+Reference: apex/fp16_utils/fp16util.py — `network_to_half:35` (cast all
+floating params to half), `convert_network:60` / `BN_convert_float`
+(cast but keep batch-norm fp32), `prep_param_lists:90` (model params +
+fp32 master copies), `master_params_to_model_params:136` /
+`model_grads_to_master_grads:162`. Pytree-functional equivalents; the
+batch-norm exemption uses the same path heuristic as amp
+(utils/tree.py is_batchnorm_path).
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.utils.tree import is_batchnorm_path, tree_cast
+
+__all__ = [
+    "network_to_half",
+    "convert_network",
+    "BN_convert_float",
+    "prep_param_lists",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+]
+
+
+def network_to_half(params: Any, dtype=jnp.float16) -> Any:
+    """Cast every floating leaf to half (reference fp16util.py:35-44)."""
+    return tree_cast(params, dtype)
+
+
+def convert_network(params: Any, dtype=jnp.float16) -> Any:
+    """Cast to half but keep batch-norm leaves fp32
+    (reference fp16util.py:60-74)."""
+    return tree_cast(params, dtype, keep_fp32_predicate=is_batchnorm_path)
+
+
+def BN_convert_float(params: Any) -> Any:
+    """Cast batch-norm leaves back to fp32 (reference fp16util.py:46-57)."""
+
+    def one(path, leaf):
+        if is_batchnorm_path(path) and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(jnp.float32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """(model_params, fp32_master_copies)
+    (reference fp16util.py:90-133; the flat-tensor variant collapses to
+    the same pytree here — packing is the optimizer's concern)."""
+    masters = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32), params
+    )
+    return params, masters
+
+
+def master_params_to_model_params(model_params: Any, master_params: Any) -> Any:
+    """Copy master values into the model tree's dtypes
+    (reference fp16util.py:136-160)."""
+    return jax.tree_util.tree_map(
+        lambda mo, ma: ma.astype(mo.dtype), model_params, master_params
+    )
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """fp32 copies of low-precision grads (reference fp16util.py:162-175)."""
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32), model_grads
+    )

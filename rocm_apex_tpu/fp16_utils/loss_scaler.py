@@ -1,0 +1,49 @@
+"""Legacy static/dynamic loss scalers.
+
+Reference: apex/fp16_utils/loss_scaler.py — `LossScaler:10` (static)
+and `DynamicLossScaler:47` (2x growth / 2x backoff with a growth
+window). Thin shims over the amp scaler with the legacy constructor
+vocabulary (scale_factor, scale_window).
+"""
+
+import jax.numpy as jnp
+
+from rocm_apex_tpu.amp.scaler import LossScaler as _AmpScaler
+from rocm_apex_tpu.amp.scaler import ScalerState, all_finite
+
+__all__ = ["LossScaler", "DynamicLossScaler"]
+
+
+class LossScaler(_AmpScaler):
+    """Static scaler (reference loss_scaler.py:10-44)."""
+
+    def __init__(self, scale: float = 1.0):
+        super().__init__(loss_scale=float(scale))
+
+    # legacy helpers (the reference exposes these names)
+    @staticmethod
+    def has_overflow(grads) -> jnp.ndarray:
+        return ~all_finite(grads)
+
+    def update_scale_legacy(self, state: ScalerState, overflow):
+        state, _ = self.update(state, overflow)
+        return state
+
+
+class DynamicLossScaler(_AmpScaler):
+    """Dynamic scaler (reference loss_scaler.py:47-119)."""
+
+    def __init__(
+        self,
+        init_scale: float = 2.0**32,
+        scale_factor: float = 2.0,
+        scale_window: int = 1000,
+    ):
+        super().__init__(
+            loss_scale="dynamic",
+            init_scale=init_scale,
+            scale_factor=scale_factor,
+            scale_window=scale_window,
+        )
+
+    has_overflow = staticmethod(LossScaler.has_overflow)

@@ -1,0 +1,89 @@
+"""Weight-norm reparameterization.
+
+Reference: apex/reparameterization/ — `apply_weight_norm`
+(__init__.py:4), `WeightNorm` (weight_norm.py:22), `Reparameterization`
+(reparameterization.py), implemented there as fp16-aware forward
+pre-hooks rewriting module weights. Functionally: a parameter tree is
+split into direction ``v`` and magnitude ``g`` with
+``w = g * v / ||v||`` (norm over all dims but `dim`), reconstructed
+before each apply — the hook becomes an explicit transform pair, which
+is also autodiff-correct for free.
+
+    wn_params = apply_weight_norm(params, names=["kernel"])
+    params    = remove_weight_norm(wn_params)   # -> plain w tree
+    # train on wn_params; inside the loss:
+    #   model.apply(reconstruct(wn_params), x)
+"""
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "apply_weight_norm",
+    "remove_weight_norm",
+    "reconstruct",
+    "weight_norm",
+]
+
+_EPS = 1e-12
+
+
+def _norm_keep(v: jnp.ndarray, dim: int) -> jnp.ndarray:
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2, axis=axes, keepdims=True))
+
+
+def weight_norm(v: jnp.ndarray, g: jnp.ndarray, dim: int = 0) -> jnp.ndarray:
+    """w = g * v / ||v|| (reference weight_norm.py:22-80; norms in fp32
+    like the fp16-aware hook)."""
+    return (g * (v.astype(jnp.float32) / (_norm_keep(v, dim) + _EPS))).astype(
+        v.dtype
+    )
+
+
+def _is_target(path, names: Optional[Sequence[str]]):
+    if names is None:
+        return True
+    last = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
+    return last in names
+
+
+def apply_weight_norm(
+    params: Any, names: Optional[Sequence[str]] = None, dim: int = 0
+) -> Any:
+    """Split matching >=2D leaves into {"v", "g"} subtrees
+    (reference: apply_weight_norm's recursive hook installation,
+    reparameterization.py)."""
+
+    def one(path, leaf):
+        if (
+            hasattr(leaf, "ndim")
+            and leaf.ndim >= 2
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+            and _is_target(path, names)
+        ):
+            return {"v": leaf, "g": _norm_keep(leaf, dim).astype(leaf.dtype)}
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _is_wn_leaf(x):
+    return isinstance(x, dict) and set(x.keys()) == {"v", "g"}
+
+
+def reconstruct(wn_params: Any, dim: int = 0) -> Any:
+    """{"v","g"} subtrees -> plain weights (called inside the loss; the
+    analogue of the forward pre-hook recomputing w each forward)."""
+    return jax.tree_util.tree_map(
+        lambda x: weight_norm(x["v"], x["g"], dim) if _is_wn_leaf(x) else x,
+        wn_params,
+        is_leaf=_is_wn_leaf,
+    )
+
+
+def remove_weight_norm(wn_params: Any, dim: int = 0) -> Any:
+    """Collapse back to plain weights (reference remove_weight_norm)."""
+    return reconstruct(wn_params, dim)

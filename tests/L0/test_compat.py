@@ -1,0 +1,149 @@
+"""Compat layers: fp16_utils, RNN, reparameterization.
+
+Mirrors tests/L0/run_fp16util + the reference's RNN smoke usage.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rocm_apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    convert_network,
+    master_params_to_model_params,
+    network_to_half,
+    prep_param_lists,
+)
+from rocm_apex_tpu.reparameterization import (
+    apply_weight_norm,
+    reconstruct,
+    remove_weight_norm,
+    weight_norm,
+)
+from rocm_apex_tpu.RNN import GRU, LSTM, RNN, mLSTM
+
+
+def params_with_bn():
+    return {
+        "conv": {"kernel": jnp.ones((3, 3, 4, 8))},
+        "bn": {"scale": jnp.ones((8,)), "mean": jnp.zeros((8,))},
+        "batch_stats": {"bn": {"var": jnp.ones((8,))}},
+    }
+
+
+class TestFp16Util:
+    def test_network_to_half(self):
+        p = network_to_half(params_with_bn())
+        assert p["conv"]["kernel"].dtype == jnp.float16
+        assert p["bn"]["scale"].dtype == jnp.float16
+
+    def test_convert_network_keeps_bn(self):
+        p = convert_network(params_with_bn())
+        assert p["conv"]["kernel"].dtype == jnp.float16
+        assert p["bn"]["scale"].dtype == jnp.float32
+
+    def test_prep_and_copy(self):
+        model = network_to_half({"w": jnp.ones((4,))})
+        model, masters = prep_param_lists(model)
+        assert masters["w"].dtype == jnp.float32
+        masters = {"w": masters["w"] * 3.0}
+        model = master_params_to_model_params(model, masters)
+        assert model["w"].dtype == jnp.float16
+        np.testing.assert_array_equal(np.asarray(model["w"]), 3.0)
+
+    def test_fp16_optimizer_trains_and_skips(self):
+        opt = FP16_Optimizer(optax.sgd(0.1), dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 2.0**8})
+        model = {"w": jnp.ones((4,), jnp.float16)}
+        state = opt.init(model)
+        scale0 = float(state.scaler_state.loss_scale)
+        good = {"w": jnp.ones((4,), jnp.float16) * scale0}
+        state = opt.step(state, good)
+        np.testing.assert_allclose(
+            np.asarray(state.master_params["w"]), 0.9, rtol=1e-3
+        )
+        bad = {"w": jnp.full((4,), jnp.inf, jnp.float16)}
+        masters_before = state.master_params
+        state = opt.step(state, bad)
+        np.testing.assert_array_equal(
+            np.asarray(state.master_params["w"]),
+            np.asarray(masters_before["w"]),
+        )
+        assert float(state.scaler_state.loss_scale) == scale0 / 2
+
+
+class TestRNN:
+    @pytest.mark.parametrize("factory", [LSTM, GRU, mLSTM])
+    def test_shapes(self, factory):
+        m = factory(8, 16, num_layers=2)
+        xs = jax.random.normal(jax.random.PRNGKey(0), (5, 3, 8))
+        params = m.init(jax.random.PRNGKey(1), xs)
+        ys, states = m.apply(params, xs)
+        assert ys.shape == (5, 3, 16)
+        assert len(states) == 2
+
+    def test_rnn_nonlinearity(self):
+        m = RNN(8, 16, nonlinearity="relu")
+        xs = jax.random.normal(jax.random.PRNGKey(2), (4, 2, 8))
+        params = m.init(jax.random.PRNGKey(3), xs)
+        ys, _ = m.apply(params, xs)
+        assert ys.shape == (4, 2, 16)
+
+    def test_bidirectional_concat(self):
+        m = LSTM(8, 16, bidirectional=True)
+        xs = jax.random.normal(jax.random.PRNGKey(4), (4, 2, 8))
+        params = m.init(jax.random.PRNGKey(5), xs)
+        ys, _ = m.apply(params, xs)
+        assert ys.shape == (4, 2, 32)
+
+    def test_lstm_matches_manual_step(self):
+        """One scan step equals the literal LSTM equations."""
+        m = LSTM(4, 4)
+        xs = jax.random.normal(jax.random.PRNGKey(6), (1, 1, 4))
+        params = m.init(jax.random.PRNGKey(7), xs)
+        ys, _ = m.apply(params, xs)
+        p = params["params"]["layer_0"]
+        gates = xs[0] @ p["w_ih"] + p["b"]
+        i, f, g, o = np.split(np.asarray(gates), 4, axis=-1)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        cy = sig(i) * np.tanh(g)
+        hy = sig(o) * np.tanh(cy)
+        np.testing.assert_allclose(np.asarray(ys[0]), hy, rtol=1e-5)
+
+
+class TestWeightNorm:
+    def test_roundtrip(self):
+        params = {"dense": {"kernel": jax.random.normal(
+            jax.random.PRNGKey(8), (6, 4))}, "bias": jnp.ones((4,))}
+        wn = apply_weight_norm(params, names=["kernel"])
+        assert set(wn["dense"]["kernel"].keys()) == {"v", "g"}
+        assert not isinstance(wn["bias"], dict)
+        back = remove_weight_norm(wn)
+        np.testing.assert_allclose(
+            np.asarray(back["dense"]["kernel"]),
+            np.asarray(params["dense"]["kernel"]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_direction_invariance(self):
+        """Scaling v leaves w unchanged (the weight-norm property)."""
+        v = jax.random.normal(jax.random.PRNGKey(9), (5, 3))
+        g = jnp.ones((5, 1)) * 2.0
+        w1 = weight_norm(v, g)
+        w2 = weight_norm(v * 7.0, g)
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), rtol=1e-5)
+
+    def test_grad_through_reconstruct(self):
+        params = {"kernel": jax.random.normal(jax.random.PRNGKey(10), (4, 4))}
+        wn = apply_weight_norm(params)
+        x = jnp.ones((2, 4))
+
+        def loss(wn):
+            w = reconstruct(wn)["kernel"]
+            return jnp.sum((x @ w) ** 2)
+
+        grads = jax.grad(loss)(wn)
+        assert np.isfinite(np.asarray(grads["kernel"]["v"])).all()
+        assert np.isfinite(np.asarray(grads["kernel"]["g"])).all()
