@@ -1,0 +1,132 @@
+"""TPU-native observability (the pyprof replacement).
+
+The reference's pyprof (reference: apex/pyprof/, deprecated in-tree)
+monkey-patches torch ops to emit NVTX ranges (nvtx/nvmarker.py:1-50),
+parses nvprof SQLite dumps (parse/), and maps kernels back to ops with
+FLOP/byte accounting (prof/). The TPU equivalents:
+
+* `annotate(name, **payload)` — `jax.profiler.TraceAnnotation` scopes
+  carrying the op name + shape/dtype payload (the NVTX marker analogue);
+* `annotate_function(fn)` — decorator form (nvmarker wraps functions);
+* `trace(log_dir)` — capture context manager over `jax.profiler.trace`;
+* `op_stats(log_dir)` — per-op device-time aggregation from the
+  captured trace (the parse/ + prof/ analogue, reading XLA's own op
+  breakdown instead of nvprof databases).
+"""
+
+import collections
+import functools
+import glob
+import gzip
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+
+__all__ = ["annotate", "annotate_function", "trace", "op_stats", "OpStat"]
+
+
+def annotate(name: str, **payload):
+    """Named trace scope; payload (shapes/dtypes/args) is folded into
+    the annotation string like the reference's marker payload
+    (reference: nvmarker.py traceMarker dict)."""
+    if payload:
+        name = f"{name}|{json.dumps(payload, default=str, sort_keys=True)}"
+    return jax.profiler.TraceAnnotation(name)
+
+
+def annotate_function(fn=None, *, name: Optional[str] = None):
+    """Decorator: run `fn` inside a named scope with arg shape/dtype
+    payload (the nvmarker function-wrap analogue)."""
+    if fn is None:
+        return functools.partial(annotate_function, name=name)
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        shapes = [
+            f"{getattr(a, 'dtype', type(a).__name__)}{list(getattr(a, 'shape', []))}"
+            for a in args
+        ]
+        with annotate(name or fn.__qualname__, args=shapes):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+class trace:
+    """`with profiler.trace('/tmp/tb'):` capture context
+    (wraps jax.profiler.trace so the import point is this package)."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._cm = None
+
+    def __enter__(self):
+        self._cm = jax.profiler.trace(self.log_dir)
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+class OpStat(
+    collections.namedtuple("OpStat", ["name", "total_ms", "count", "category"])
+):
+    __slots__ = ()
+
+
+def op_stats(
+    log_dir: str, top: int = 0, merge_numeric_suffix: bool = True
+) -> List[OpStat]:
+    """Aggregate per-op device time from the newest capture in
+    `log_dir` (reads the trace.json.gz XLA-op timeline; the pyprof
+    parse/prof analogue). `merge_numeric_suffix` folds fusion.12 /
+    fusion.34 into one row."""
+    files = sorted(
+        glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz")
+    )
+    if not files:
+        raise FileNotFoundError(f"no captured trace under {log_dir}")
+    with gzip.open(files[-1]) as f:
+        data = json.load(f)
+
+    names: Dict[Any, str] = {}
+    tids: Dict[Any, str] = {}
+    for e in data.get("traceEvents", []):
+        if e.get("ph") == "M":
+            if e.get("name") == "process_name":
+                names[e["pid"]] = e["args"].get("name", "")
+            elif e.get("name") == "thread_name":
+                tids[(e["pid"], e["tid"])] = e["args"].get("name", "")
+    # any process with an "XLA Ops" thread is a device timeline (TPU
+    # process names on the tunnel platform; CPU traces lack them)
+    device_pids = {
+        p for (p, t), n in tids.items() if n == "XLA Ops"
+    } | {p for p, n in names.items() if "TPU" in n or "GPU" in n}
+
+    tot = collections.Counter()
+    cnt = collections.Counter()
+    cat = {}
+    for e in data.get("traceEvents", []):
+        if (
+            e.get("ph") == "X"
+            and e.get("dur", 0) > 0
+            and e.get("pid") in device_pids
+            and tids.get((e["pid"], e["tid"])) == "XLA Ops"
+        ):
+            base = e["name"]
+            if merge_numeric_suffix:
+                base = re.sub(r"[.\d]+$", "", base)
+            tot[base] += e["dur"]
+            cnt[base] += 1
+            cat.setdefault(
+                base, (e.get("args") or {}).get("hlo_category", "")
+            )
+
+    stats = [
+        OpStat(n, tot[n] / 1e3, cnt[n], cat.get(n, ""))
+        for n in tot
+    ]
+    stats.sort(key=lambda s: -s.total_ms)
+    return stats[:top] if top else stats

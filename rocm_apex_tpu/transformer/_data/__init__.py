@@ -1,0 +1,11 @@
+"""Megatron pretraining batch samplers.
+
+Reference: apex/transformer/_data/_batchsampler.py:37-180.
+"""
+
+from rocm_apex_tpu.transformer._data._batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+__all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]

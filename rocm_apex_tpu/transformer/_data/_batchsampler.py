@@ -1,0 +1,144 @@
+"""DP-sharded pretraining batch samplers.
+
+Rebuild of the reference samplers
+(reference: apex/transformer/_data/_batchsampler.py —
+`MegatronPretrainingSampler:37` sequential, `MegatronPretrainingRandomSampler`
+epoch-seeded shuffled buckets). Framework-agnostic index iterators:
+each `__iter__` yields this data-parallel rank's local minibatch of
+dataset indices, resumable via `consumed_samples`. torch's seeded
+`randperm` becomes numpy's (same role: deterministic per epoch).
+"""
+
+import numpy as np
+
+__all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]
+
+
+class MegatronPretrainingSampler:
+    """Sequential DP-sharded sampler (reference :37-99)."""
+
+    def __init__(
+        self,
+        total_samples: int,
+        consumed_samples: int,
+        local_minibatch_size: int,
+        data_parallel_rank: int,
+        data_parallel_size: int,
+        drop_last: bool = True,
+    ):
+        if total_samples <= 0:
+            raise RuntimeError(f"no sample to consume: {total_samples}")
+        if consumed_samples >= total_samples:
+            raise RuntimeError(
+                f"no samples left to consume: {consumed_samples}, "
+                f"{total_samples}"
+            )
+        if local_minibatch_size <= 0:
+            raise RuntimeError(
+                "local minibatch size must be greater than 0: "
+                f"{local_minibatch_size}"
+            )
+        if data_parallel_size <= 0:
+            raise RuntimeError(
+                f"data parallel size must be greater than 0: {data_parallel_size}"
+            )
+        if data_parallel_rank >= data_parallel_size:
+            raise RuntimeError(
+                "data_parallel_rank should be smaller than data size: "
+                f"{data_parallel_rank}, {data_parallel_size}"
+            )
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.local_minibatch_size = local_minibatch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.drop_last = drop_last
+
+    def __len__(self):
+        return self.total_samples
+
+    def get_start_end_idx(self):
+        start = self.data_parallel_rank * self.local_minibatch_size
+        return start, start + self.local_minibatch_size
+
+    def __iter__(self):
+        # Deliberate deviation: the reference accumulates only
+        # local_minibatch_size indices before rank-slicing
+        # (_batchsampler.py:86-99), which yields empty batches for every
+        # rank > 0; upstream Megatron accumulates batch_size *
+        # data_parallel_size. We accumulate lms * dp so each rank gets
+        # its disjoint window.
+        batch = []
+        full = self.local_minibatch_size * self.data_parallel_size
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == full:
+                start, end = self.get_start_end_idx()
+                yield batch[start:end]
+                batch = []
+        if batch and not self.drop_last:
+            start, end = self.get_start_end_idx()
+            yield batch[start:end]
+
+
+class MegatronPretrainingRandomSampler:
+    """Shuffled DP-sharded sampler; epoch-seeded permutation over this
+    rank's bucket (reference :103-180)."""
+
+    def __init__(
+        self,
+        total_samples: int,
+        consumed_samples: int,
+        local_minibatch_size: int,
+        data_parallel_rank: int,
+        data_parallel_size: int,
+    ):
+        if total_samples <= 0:
+            raise ValueError(f"no sample to consume: {total_samples}")
+        if local_minibatch_size <= 0:
+            raise ValueError(f"Invalid local_minibatch_size: {local_minibatch_size}")
+        if data_parallel_size <= 0:
+            raise ValueError(f"Invalid data_parallel_size: {data_parallel_size}")
+        if data_parallel_rank >= data_parallel_size:
+            raise ValueError(
+                "data_parallel_rank should be smaller than data parallel "
+                f"size: {data_parallel_rank} < {data_parallel_size}"
+            )
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.local_minibatch_size = local_minibatch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.local_minibatch_times_data_parallel_size = (
+            local_minibatch_size * data_parallel_size
+        )
+        self.last_batch_size = (
+            total_samples % self.local_minibatch_times_data_parallel_size
+        )
+
+    def __len__(self):
+        return self.total_samples
+
+    def __iter__(self):
+        active = self.total_samples - self.last_batch_size
+        self.epoch = self.consumed_samples // active
+        current_epoch_samples = self.consumed_samples % active
+        bucket_size = (
+            self.total_samples // self.local_minibatch_times_data_parallel_size
+        ) * self.local_minibatch_size
+        bucket_offset = current_epoch_samples // self.data_parallel_size
+        start_idx = self.data_parallel_rank * bucket_size
+
+        rng = np.random.default_rng(self.epoch)
+        random_idx = rng.permutation(bucket_size).tolist()
+        idx_range = [start_idx + x for x in random_idx[bucket_offset:]]
+
+        batch = []
+        for idx in idx_range:
+            batch.append(idx)
+            if len(batch) == self.local_minibatch_size:
+                self.consumed_samples += (
+                    self.local_minibatch_times_data_parallel_size
+                )
+                yield batch
+                batch = []

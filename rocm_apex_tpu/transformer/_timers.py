@@ -1,0 +1,86 @@
+"""Named timers with device synchronization.
+
+Reference: apex/transformer/pipeline_parallel/_timers.py:1-83
+(`_Timer` with `torch.cuda.synchronize()` around start/stop, `Timers`
+registry with `log`). On this platform synchronization means a value
+fetch (see bench.py note: `block_until_ready` alone does not sync the
+tunnel transport), so `stop` optionally takes an array to fetch.
+"""
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = ["Timers"]
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+
+    def start(self):
+        assert not self.started_, f"timer {self.name} already started"
+        self.started_ = True
+        self.start_time = time.perf_counter()
+
+    def stop(self, sync_on=None):
+        assert self.started_, f"timer {self.name} is not started"
+        if sync_on is not None:
+            np.asarray(jax.device_get(sync_on))  # true device sync
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        was_started = self.started_
+        if was_started:
+            self.stop()
+        out = self.elapsed_
+        if reset:
+            self.reset()
+        if was_started:
+            self.start()
+        return out
+
+
+class Timers:
+    """Registry (reference _timers.py Timers.__call__/log)."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(
+        self,
+        names,
+        normalizer: float = 1.0,
+        reset: bool = True,
+        printer=print,
+    ):
+        assert normalizer > 0.0
+        parts = ["time (ms)"]
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        printer(" | ".join(parts))
+
+    def write(self, names, writer, iteration, normalizer=1.0, reset=False):
+        """Tensorboard-style hook (reference _timers.py write)."""
+        assert normalizer > 0.0
+        for name in names:
+            if name in self.timers:
+                value = self.timers[name].elapsed(reset=reset) / normalizer
+                writer.add_scalar(f"{name}-time", value, iteration)

@@ -1,0 +1,123 @@
+"""FusedScaleMaskSoftmax: kernel-eligibility dispatch + fallback.
+
+Rebuild of the reference module
+(reference: apex/transformer/functional/fused_softmax.py —
+`ScaledUpperTriangMaskedSoftmax:21`, `ScaledMaskedSoftmax:67` autograd
+wrappers over the megatron kernels, and `FusedScaleMaskSoftmax:95`
+whose `is_kernel_available:155-174` gates on fp16/bf16 dtype and
+16 < seq_k <= 2048 divisibility before falling back to
+`forward_torch_softmax:184`).
+
+The Pallas kernels (ops/softmax.py) have no 2048 ceiling, so the
+eligibility check shrinks to "floating input + kernel enabled"; the
+reference's constraint surface is kept as attributes so callers can
+still reason about it, and the jnp fallback reproduces
+forward_torch_softmax exactly (mask fill with -10000.0).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from rocm_apex_tpu.transformer.enums import AttnMaskType
+
+__all__ = [
+    "ScaledUpperTriangMaskedSoftmax",
+    "ScaledMaskedSoftmax",
+    "FusedScaleMaskSoftmax",
+]
+
+
+def ScaledUpperTriangMaskedSoftmax(x, scale: float = 1.0):
+    """(b, sq, sk) causal scaled softmax (reference fused_softmax.py:21-64;
+    kernel csrc/megatron/scaled_upper_triang_masked_softmax*)."""
+    return scaled_upper_triang_masked_softmax(x, scale)
+
+
+def ScaledMaskedSoftmax(x, mask, scale: float = 1.0):
+    """(b, n, sq, sk) scaled softmax with bool padding mask
+    (True = masked) (reference fused_softmax.py:67-92)."""
+    return scaled_masked_softmax(x, mask, scale)
+
+
+class FusedScaleMaskSoftmax:
+    """Dispatching softmax (reference fused_softmax.py:95-199).
+
+    Constructor mirrors the reference: input/softmax fp16|bf16 flags,
+    attn_mask_type, masked-softmax fusion toggle, optional mask_func
+    for the fallback, softmax_in_fp32, scale.
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = True,
+        attn_mask_type: AttnMaskType = AttnMaskType.causal,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError("both fp16 and bf16 flags cannot be active")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError("softmax should be in fp32 when scaled")
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """The reference gates on dtype + 16 < sk <= 2048 + divisibility
+        (fused_softmax.py:155-174); the Pallas kernels only need a
+        floating input and the fusion toggle."""
+        return bool(self.scaled_masked_softmax_fusion and sk > 1)
+
+    def __call__(self, x, mask=None):
+        b, np_, sq, sk = x.shape
+        scale = self.scale if self.scale is not None else 1.0
+        if self.is_kernel_available(mask, b, np_, sq, sk):
+            if self.attn_mask_type == AttnMaskType.causal:
+                assert sq == sk, "causal mask is only for self attention"
+                probs = scaled_upper_triang_masked_softmax(
+                    x.reshape(-1, sq, sk), scale
+                )
+                return probs.reshape(b, np_, sq, sk)
+            if mask is not None:
+                return scaled_masked_softmax(x, mask, scale)
+            # no mask: plain scaled softmax via the masked kernel
+            zeros = jnp.zeros((b, 1, sq, sk), bool)
+            return scaled_masked_softmax(x, zeros, scale)
+        return self.forward_jnp_softmax(x, mask)
+
+    def forward_jnp_softmax(self, x, mask):
+        """forward_torch_softmax semantics (reference
+        fused_softmax.py:184-199): optional fp32 upcast, scale,
+        mask_func (default fill -10000.0), softmax, cast back."""
+        orig = x.dtype
+        if self.input_in_float16 and self.softmax_in_fp32:
+            x = x.astype(jnp.float32)
+        if self.scale is not None:
+            x = x * self.scale
+        if self.attn_mask_type == AttnMaskType.causal:
+            sq, sk = x.shape[-2], x.shape[-1]
+            causal = ~jnp.tril(jnp.ones((sq, sk), bool))
+            mask = causal if mask is None else (mask | causal)
+        if mask is not None:
+            fill = self.mask_func or (
+                lambda x, m: jnp.where(m, -10000.0, x)
+            )
+            x = fill(x, mask)
+        probs = jax.nn.softmax(x, axis=-1)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            probs = probs.astype(orig)
+        return probs

@@ -1,0 +1,28 @@
+"""Test/bring-up utilities: Megatron-style args, globals, toy models.
+
+Reference: apex/transformer/testing/ — arguments.py (806 LoC argparse =
+the de-facto Megatron config schema), global_vars.py (singleton
+args/timers), commons.py (initialize_distributed, toy MyModel).
+"""
+
+from rocm_apex_tpu.transformer.testing.arguments import parse_args  # noqa: F401
+from rocm_apex_tpu.transformer.testing.commons import (  # noqa: F401
+    MyLayer,
+    MyModel,
+    initialize_mesh,
+)
+from rocm_apex_tpu.transformer.testing.global_vars import (  # noqa: F401
+    get_args,
+    get_timers,
+    set_global_variables,
+)
+
+__all__ = [
+    "parse_args",
+    "get_args",
+    "get_timers",
+    "set_global_variables",
+    "initialize_mesh",
+    "MyLayer",
+    "MyModel",
+]

@@ -1,0 +1,229 @@
+"""Megatron-style argument system.
+
+Rebuild of the reference's de-facto config schema
+(reference: apex/transformer/testing/arguments.py, 806 LoC — the full
+Megatron argparser grouped as model/regularization/training/
+initialization/learning-rate/checkpointing/mixed-precision/distributed/
+validation/data groups, with `parse_args(extra_args_provider,
+defaults, ignore_unknown_args)` and post-parse consistency checks).
+
+This carries the same group structure and the flags the framework
+consumes; CUDA-only knobs keep their names where downstream scripts
+pass them (accepted, unused) and are marked so. Consistency checks
+mirror the reference's (world-size divisibility, fp16/bf16 exclusivity,
+virtual-pipeline constraints).
+"""
+
+import argparse
+import os
+
+__all__ = ["parse_args"]
+
+
+def parse_args(extra_args_provider=None, defaults=None,
+               ignore_unknown_args=False, args=None):
+    parser = argparse.ArgumentParser(
+        description="rocm_apex_tpu Arguments", allow_abbrev=False
+    )
+    _add_model_config_args(parser)
+    _add_regularization_args(parser)
+    _add_training_args(parser)
+    _add_initialization_args(parser)
+    _add_learning_rate_args(parser)
+    _add_checkpointing_args(parser)
+    _add_mixed_precision_args(parser)
+    _add_distributed_args(parser)
+    _add_validation_args(parser)
+    _add_data_args(parser)
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if ignore_unknown_args:
+        parsed, _ = parser.parse_known_args(args)
+    else:
+        parsed = parser.parse_args(args)
+
+    if defaults:
+        for k, v in defaults.items():
+            if getattr(parsed, k, None) is None:
+                setattr(parsed, k, v)
+
+    # consistency checks (reference arguments.py post-parse validation)
+    import jax
+
+    parsed.world_size = int(
+        os.environ.get("WORLD_SIZE", jax.device_count())
+    )
+    model_size = (
+        parsed.tensor_model_parallel_size * parsed.pipeline_model_parallel_size
+    )
+    if parsed.world_size % model_size != 0:
+        raise ValueError(
+            f"world size ({parsed.world_size}) is not divisible by tensor "
+            f"({parsed.tensor_model_parallel_size}) x pipeline "
+            f"({parsed.pipeline_model_parallel_size}) parallel sizes"
+        )
+    parsed.data_parallel_size = parsed.world_size // model_size
+    if parsed.fp16 and parsed.bf16:
+        raise ValueError("cannot specify both fp16 and bf16")
+    if parsed.virtual_pipeline_model_parallel_size is not None:
+        if parsed.pipeline_model_parallel_size <= 2:
+            raise ValueError(
+                "pipeline-model-parallel size should be greater than 2 "
+                "with interleaved schedule"
+            )
+        if (
+            parsed.num_layers
+            % (
+                parsed.virtual_pipeline_model_parallel_size
+                * parsed.pipeline_model_parallel_size
+            )
+            != 0
+        ):
+            raise ValueError(
+                "number of layers is not divisible by number of model chunks"
+            )
+    if parsed.ffn_hidden_size is None:
+        parsed.ffn_hidden_size = 4 * parsed.hidden_size
+    if parsed.kv_channels is None:
+        assert parsed.hidden_size % parsed.num_attention_heads == 0
+        parsed.kv_channels = parsed.hidden_size // parsed.num_attention_heads
+    return parsed
+
+
+def _add_model_config_args(p):
+    g = p.add_argument_group("model")
+    g.add_argument("--num-layers", type=int, default=None)
+    g.add_argument("--hidden-size", type=int, default=None)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--num-attention-heads", type=int, default=None)
+    g.add_argument("--kv-channels", type=int, default=None)
+    g.add_argument("--max-position-embeddings", type=int, default=None)
+    g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    g.add_argument("--apply-residual-connection-post-layernorm",
+                   action="store_true")
+    g.add_argument("--openai-gelu", action="store_true")
+    g.add_argument("--onnx-safe", action="store_true")
+
+
+def _add_regularization_args(p):
+    g = p.add_argument_group("regularization")
+    g.add_argument("--attention-dropout", type=float, default=0.1)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--adam-beta1", type=float, default=0.9)
+    g.add_argument("--adam-beta2", type=float, default=0.999)
+    g.add_argument("--adam-eps", type=float, default=1e-8)
+    g.add_argument("--sgd-momentum", type=float, default=0.9)
+
+
+def _add_training_args(p):
+    g = p.add_argument_group("training")
+    g.add_argument("--micro-batch-size", type=int, default=None)
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs="*", default=None)
+    g.add_argument("--checkpoint-activations", action="store_true")
+    g.add_argument("--distribute-checkpointed-activations",
+                   action="store_true")
+    g.add_argument("--train-iters", type=int, default=None)
+    g.add_argument("--train-samples", type=int, default=None)
+    g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--exit-interval", type=int, default=None)
+    g.add_argument("--tensorboard-dir", type=str, default=None)
+    g.add_argument("--optimizer", type=str, default="adam",
+                   choices=["adam", "sgd", "lamb"])
+    g.add_argument("--use-cpu-initialization", action="store_true",
+                   help="accepted for parity; initialization is functional")
+
+
+def _add_initialization_args(p):
+    g = p.add_argument_group("initialization")
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--init-method-std", type=float, default=0.02)
+    g.add_argument("--init-method-xavier-uniform", action="store_true")
+
+
+def _add_learning_rate_args(p):
+    g = p.add_argument_group("learning rate")
+    g.add_argument("--lr", type=float, default=None)
+    g.add_argument("--lr-decay-style", type=str, default="linear",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument("--lr-decay-iters", type=int, default=None)
+    g.add_argument("--lr-decay-samples", type=int, default=None)
+    g.add_argument("--lr-warmup-fraction", type=float, default=None)
+    g.add_argument("--lr-warmup-iters", type=int, default=0)
+    g.add_argument("--lr-warmup-samples", type=int, default=0)
+    g.add_argument("--min-lr", type=float, default=0.0)
+    g.add_argument("--override-lr-scheduler", action="store_true")
+    g.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
+
+
+def _add_checkpointing_args(p):
+    g = p.add_argument_group("checkpointing")
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--save-interval", type=int, default=None)
+    g.add_argument("--no-save-optim", action="store_true")
+    g.add_argument("--no-save-rng", action="store_true")
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--no-load-optim", action="store_true")
+    g.add_argument("--no-load-rng", action="store_true")
+    g.add_argument("--finetune", action="store_true")
+
+
+def _add_mixed_precision_args(p):
+    g = p.add_argument_group("mixed precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None)
+    g.add_argument("--initial-loss-scale", type=float, default=2**32)
+    g.add_argument("--min-loss-scale", type=float, default=1.0)
+    g.add_argument("--loss-scale-window", type=float, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--fp32-residual-connection", action="store_true")
+    g.add_argument("--no-query-key-layer-scaling", action="store_false",
+                   dest="apply_query_key_layer_scaling")
+    g.add_argument("--attention-softmax-in-fp32", action="store_true")
+    g.add_argument("--accumulate-allreduce-grads-in-fp32",
+                   action="store_true")
+    g.add_argument("--fp16-lm-cross-entropy", action="store_true")
+
+
+def _add_distributed_args(p):
+    g = p.add_argument_group("distributed")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--distributed-backend", default="xla",
+                   choices=["xla", "nccl", "gloo"],
+                   help="accepted for parity; collectives are XLA's")
+    g.add_argument("--DDP-impl", default="local",
+                   choices=["local", "torch"],
+                   help="accepted for parity")
+    g.add_argument("--local_rank", type=int, default=None)
+    g.add_argument("--lazy-mpu-init", type=bool, default=None)
+    g.add_argument("--use-ring-exchange-p2p", action="store_true")
+    g.add_argument("--scatter-gather-tensors-in-pipeline",
+                   action="store_true")
+
+
+def _add_validation_args(p):
+    g = p.add_argument_group("validation")
+    g.add_argument("--eval-iters", type=int, default=100)
+    g.add_argument("--eval-interval", type=int, default=1000)
+
+
+def _add_data_args(p):
+    g = p.add_argument_group("data")
+    g.add_argument("--data-path", nargs="*", default=None)
+    g.add_argument("--split", type=str, default="969, 30, 1")
+    g.add_argument("--vocab-file", type=str, default=None)
+    g.add_argument("--merge-file", type=str, default=None)
+    g.add_argument("--seq-length", type=int, default=None)
+    g.add_argument("--encoder-seq-length", type=int, default=None)
+    g.add_argument("--decoder-seq-length", type=int, default=None)
+    g.add_argument("--num-workers", type=int, default=2)
+    g.add_argument("--reset-position-ids", action="store_true")
+    g.add_argument("--reset-attention-mask", action="store_true")
+    g.add_argument("--eod-mask-loss", action="store_true")

@@ -1,0 +1,65 @@
+"""Shared test utilities: mesh bring-up + toy pipeline models.
+
+Reference: apex/transformer/testing/commons.py —
+`initialize_distributed:70-123` (env-driven process-group setup) and
+the one-linear-layer `MyModel:31-60` used to validate the pipeline
+schedules before the full GPT.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.transformer import parallel_state
+
+__all__ = ["initialize_mesh", "MyLayer", "MyModel"]
+
+
+def initialize_mesh(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    devices=None,
+):
+    """Mesh bring-up for tests (the analogue of the reference's
+    `initialize_distributed`, commons.py:70-123 — env-var process
+    groups become one mesh construction)."""
+    parallel_state.destroy_model_parallel()
+    return parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size,
+        pipeline_model_parallel_size,
+        virtual_pipeline_model_parallel_size,
+        devices=devices,
+    )
+
+
+def MyLayer(hidden_size: int, pre_process: bool = False,
+            post_process: bool = False):
+    """One toy stage: tanh(x @ w + b) — the stage_fn form the pipeline
+    schedules consume (reference MyModel implements set_input_tensor
+    for the same purpose, commons.py:31-60)."""
+    del pre_process, post_process  # stage position is implicit in SPMD
+
+    def init(key):
+        kw, kb = jax.random.split(key)
+        return {
+            "w": jax.random.normal(kw, (hidden_size, hidden_size))
+            / jnp.sqrt(hidden_size),
+            "b": jnp.zeros((hidden_size,)),
+        }
+
+    def apply(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    return init, apply
+
+
+def MyModel(hidden_size: int, n_stages: int, key=None):
+    """Stage-stacked toy model for the schedules: returns
+    (stacked_params, stage_fn)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    init, apply = MyLayer(hidden_size)
+    params = [init(jax.random.fold_in(key, i)) for i in range(n_stages)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params)
+    return stacked, apply

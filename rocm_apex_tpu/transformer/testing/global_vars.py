@@ -1,0 +1,57 @@
+"""Global args/timers singletons.
+
+Reference: apex/transformer/testing/global_vars.py:1-270 — `get_args`,
+`get_timers`, `set_global_variables`, each guarded by
+is-initialized assertions.
+"""
+
+from typing import Optional
+
+from rocm_apex_tpu.transformer._timers import Timers
+
+__all__ = ["get_args", "get_timers", "set_global_variables"]
+
+_GLOBAL_ARGS = None
+_GLOBAL_TIMERS = None
+
+
+def _ensure(var, name):
+    if var is None:
+        raise AssertionError(f"{name} is not initialized.")
+    return var
+
+
+def get_args():
+    return _ensure(_GLOBAL_ARGS, "args")
+
+
+def get_timers() -> Timers:
+    return _ensure(_GLOBAL_TIMERS, "timers")
+
+
+def set_global_variables(
+    extra_args_provider=None,
+    args_defaults: Optional[dict] = None,
+    ignore_unknown_args: bool = False,
+    args=None,
+):
+    """Parse args + build timers (reference global_vars.py:87-270)."""
+    global _GLOBAL_ARGS, _GLOBAL_TIMERS
+    from rocm_apex_tpu.transformer.testing.arguments import parse_args
+
+    if _GLOBAL_ARGS is not None:
+        raise AssertionError("args is already initialized.")
+    _GLOBAL_ARGS = parse_args(
+        extra_args_provider=extra_args_provider,
+        defaults=args_defaults,
+        ignore_unknown_args=ignore_unknown_args,
+        args=args,
+    )
+    _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_ARGS
+
+
+def _destroy_global_vars():
+    global _GLOBAL_ARGS, _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_TIMERS = None

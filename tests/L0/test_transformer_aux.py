@@ -1,0 +1,139 @@
+"""Transformer aux: FusedScaleMaskSoftmax, enums, samplers, timers, args.
+
+Mirrors tests/L0/run_transformer/test_fused_softmax.py (fused vs torch
+fallback) and the dynamic-batch / argument-system usage.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_apex_tpu.transformer._data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+from rocm_apex_tpu.transformer._timers import Timers
+from rocm_apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType
+from rocm_apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+from rocm_apex_tpu.transformer.testing import parse_args
+from rocm_apex_tpu.transformer.testing import global_vars
+
+
+class TestFusedScaleMaskSoftmax:
+    def test_causal_fused_vs_fallback(self):
+        """Kernel output == forward_torch_softmax fallback
+        (reference: tests/L0/run_transformer/test_fused_softmax.py)."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 32, 32))
+        fused = FusedScaleMaskSoftmax(
+            input_in_bf16=False, attn_mask_type=AttnMaskType.causal,
+            scale=0.5,
+        )
+        fallback = FusedScaleMaskSoftmax(
+            input_in_bf16=False, attn_mask_type=AttnMaskType.causal,
+            scaled_masked_softmax_fusion=False, scale=0.5,
+        )
+        a, b = fused(x), fallback(x)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+    def test_padding_mask(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 8, 16))
+        mask = jnp.zeros((2, 1, 8, 16), bool).at[:, :, :, 10:].set(True)
+        fused = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.padding)
+        probs = fused(x, mask)
+        # masked keys get ~zero probability
+        assert float(np.asarray(probs)[:, :, :, 10:].max()) < 1e-4
+
+    def test_fp16_bf16_exclusive(self):
+        with pytest.raises(RuntimeError, match="both"):
+            FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+
+    def test_enums(self):
+        assert LayerType.encoder.value == 1
+        assert AttnType.cross_attn.value == 2
+        assert AttnMaskType.causal.value == 2
+
+
+class TestSamplers:
+    def test_sequential_shards_by_rank(self):
+        s0 = MegatronPretrainingSampler(32, 0, 4, 0, 2)
+        s1 = MegatronPretrainingSampler(32, 0, 4, 1, 2)
+        b0, b1 = next(iter(s0)), next(iter(s1))
+        assert b0 == [0, 1, 2, 3] and b1 == [4, 5, 6, 7]
+
+    def test_sequential_resume(self):
+        s = MegatronPretrainingSampler(32, 8, 4, 0, 1)
+        assert next(iter(s)) == [8, 9, 10, 11]
+
+    def test_random_deterministic_per_epoch(self):
+        a = list(MegatronPretrainingRandomSampler(64, 0, 4, 0, 2))
+        b = list(MegatronPretrainingRandomSampler(64, 0, 4, 0, 2))
+        assert a == b
+        # ranks see disjoint buckets
+        r0 = set(x for batch in a for x in batch)
+        r1 = set(
+            x
+            for batch in MegatronPretrainingRandomSampler(64, 0, 4, 1, 2)
+            for x in batch
+        )
+        assert r0.isdisjoint(r1)
+
+    def test_validation_errors(self):
+        with pytest.raises(RuntimeError, match="no sample"):
+            MegatronPretrainingSampler(0, 0, 4, 0, 1)
+        with pytest.raises(ValueError, match="data_parallel_rank"):
+            MegatronPretrainingRandomSampler(8, 0, 2, 3, 2)
+
+
+class TestTimers:
+    def test_accumulates(self):
+        t = Timers()
+        t("fwd").start()
+        t("fwd").stop()
+        assert t("fwd").elapsed(reset=False) >= 0.0
+        lines = []
+        t.log(["fwd"], printer=lines.append)
+        assert "fwd" in lines[0]
+
+
+class TestArguments:
+    def test_parse_core_flags(self):
+        args = parse_args(args=[
+            "--num-layers", "4", "--hidden-size", "64",
+            "--num-attention-heads", "4", "--micro-batch-size", "2",
+            "--bf16",
+        ])
+        assert args.ffn_hidden_size == 256  # 4 * hidden
+        assert args.kv_channels == 16
+        assert args.bf16 and not args.fp16
+        assert args.data_parallel_size >= 1
+
+    def test_fp16_bf16_conflict(self):
+        with pytest.raises(ValueError, match="both"):
+            parse_args(args=["--num-layers", "2", "--hidden-size", "8",
+                             "--num-attention-heads", "2",
+                             "--fp16", "--bf16"])
+
+    def test_world_divisibility(self):
+        with pytest.raises(ValueError, match="divisible"):
+            parse_args(args=[
+                "--num-layers", "2", "--hidden-size", "8",
+                "--num-attention-heads", "2",
+                "--tensor-model-parallel-size", "3",
+            ])
+
+    def test_global_vars_singleton(self):
+        global_vars._destroy_global_vars()
+        global_vars.set_global_variables(args=[
+            "--num-layers", "2", "--hidden-size", "8",
+            "--num-attention-heads", "2",
+        ])
+        assert global_vars.get_args().num_layers == 2
+        assert global_vars.get_timers() is not None
+        with pytest.raises(AssertionError, match="already"):
+            global_vars.set_global_variables(args=[])
+        global_vars._destroy_global_vars()
+        with pytest.raises(AssertionError, match="not initialized"):
+            global_vars.get_args()
