@@ -1,0 +1,102 @@
+// Host-native runtime ops for rocm_apex_tpu.
+//
+// TPU-native equivalent of the reference's host-side native layer:
+//  * flatten/unflatten of tensor buckets (reference:
+//    csrc/flatten_unflatten.cpp, the apex_C extension backing DDP's
+//    bucket packing, apex/parallel/distributed.py:13-33). On TPU the
+//    DEVICE-side packing belongs to XLA (see optimizers/mixed.py for
+//    the measurement); the host-side version remains the fast path for
+//    checkpoint IO and input staging of many small arrays.
+//  * fast_collate (reference: examples/imagenet/main_amp.py
+//    fast_collate + data_prefetcher): uint8 HWC image batches to a
+//    normalized float NHWC buffer without a Python-loop per image.
+//
+// Plain C ABI (no pybind11 in this image); bound via ctypes from
+// rocm_apex_tpu/_native/__init__.py with a numpy fallback.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(i) for i in [0, n) over up to `threads` std::threads.
+template <typename F>
+void parallel_for(int64_t n, int threads, F fn) {
+  if (n <= 0) return;
+  int t = threads;
+  if (t > n) t = static_cast<int>(n);
+  if (t <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(t);
+  for (int k = 0; k < t; ++k) {
+    pool.emplace_back([k, t, n, &fn]() {
+      for (int64_t i = k; i < n; i += t) fn(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Concatenate n buffers (sizes[i] elements of elem_size bytes) into dst.
+void apex_tpu_flatten(const void** srcs, const int64_t* sizes, int64_t n,
+                      int64_t elem_size, void* dst, int threads) {
+  std::vector<int64_t> offsets(n);
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    offsets[i] = off;
+    off += sizes[i];
+  }
+  char* out = static_cast<char*>(dst);
+  parallel_for(n, threads, [&](int64_t i) {
+    std::memcpy(out + offsets[i] * elem_size, srcs[i],
+                static_cast<size_t>(sizes[i] * elem_size));
+  });
+}
+
+// Split src back into n buffers.
+void apex_tpu_unflatten(const void* src, const int64_t* sizes, int64_t n,
+                        int64_t elem_size, void** dsts, int threads) {
+  std::vector<int64_t> offsets(n);
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    offsets[i] = off;
+    off += sizes[i];
+  }
+  const char* in = static_cast<const char*>(src);
+  parallel_for(n, threads, [&](int64_t i) {
+    std::memcpy(dsts[i], in + offsets[i] * elem_size,
+                static_cast<size_t>(sizes[i] * elem_size));
+  });
+}
+
+// n uint8 HWC images -> float32 NHWC batch, normalized (x/255 - mean)/std
+// per channel. mean/std may be null (skip normalization, keep 0..255
+// like the reference's fast_collate which defers normalization).
+void apex_tpu_fast_collate(const uint8_t** imgs, int64_t n, int64_t h,
+                           int64_t w, int64_t c, float* dst,
+                           const float* mean, const float* std_,
+                           int threads) {
+  const int64_t hwc = h * w * c;
+  parallel_for(n, threads, [&](int64_t i) {
+    const uint8_t* src = imgs[i];
+    float* out = dst + i * hwc;
+    if (mean && std_) {
+      for (int64_t p = 0; p < hwc; ++p) {
+        const int64_t ch = p % c;
+        out[p] = (src[p] * (1.0f / 255.0f) - mean[ch]) / std_[ch];
+      }
+    } else {
+      for (int64_t p = 0; p < hwc; ++p) out[p] = src[p];
+    }
+  });
+}
+
+}  // extern "C"
