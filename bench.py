@@ -31,7 +31,7 @@ from rocm_apex_tpu.amp import LossScaler, all_finite
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
 from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
 
-BATCH = 8
+BATCH = 16
 SEQ = 1024
 ITERS = 10  # one warmup runN (compile + state settle) then one timed
 

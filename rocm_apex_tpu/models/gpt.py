@@ -87,6 +87,10 @@ class GPTConfig:
     # flash has no in-kernel dropout: with attention_dropout > 0 in
     # training mode the fused_softmax path is used instead.
     attention_impl: str = "flash"
+    # per-layer activation checkpointing (reference:
+    # tensor_parallel/random.py:224-293 CheckpointFunction; here it is
+    # jax.checkpoint/remat — RNG replay is free with functional PRNG)
+    checkpoint_activations: bool = False
 
     @property
     def ffn_size(self) -> int:
@@ -318,8 +322,13 @@ class ParallelTransformer(nn.Module):
     @nn.compact
     def __call__(self, x, attention_mask=None, deterministic: bool = True):
         n = self.num_layers or self.cfg.num_layers
+        layer_cls = ParallelTransformerLayer
+        if self.cfg.checkpoint_activations:
+            layer_cls = nn.remat(
+                ParallelTransformerLayer, static_argnums=(3,)
+            )
         for i in range(n):
-            x = ParallelTransformerLayer(
+            x = layer_cls(
                 self.cfg, self.attn_mask_type, name=f"layer_{i}"
             )(x, attention_mask, deterministic)
         if self.post_layer_norm:

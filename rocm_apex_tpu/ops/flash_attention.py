@@ -33,7 +33,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from rocm_apex_tpu.ops._pallas import pallas_call
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 # Large blocks keep the sequential TPU grid short (per-step overhead is
 # the dominant cost at small blocks) while staying well inside VMEM:
@@ -309,7 +309,7 @@ def _bwd_dq_kernel(
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd(causal, scale, block_q, block_k, res, do):
+def _bwd(causal, scale, block_q, block_k, res, do, dlse=None):
     q, k, v, bias, o, lse = res
     bh, sq, d0 = q.shape
     sk = k.shape[1]
@@ -321,6 +321,10 @@ def _bwd(causal, scale, block_q, block_k, res, do):
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # (bh, sq)
+    if dlse is not None:
+        # lse cotangent: d lse / d s = p, so ds = p*(dp - delta + dlse)
+        # — dlse folds into delta with opposite sign
+        delta = delta - dlse.astype(jnp.float32)
     qp = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, d - d0)))
     kp = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d - d0)))
     vp = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d - d0)))
@@ -468,3 +472,48 @@ def _fa_bwd(causal, scale, block_q, block_k, res, do):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+):
+    """`flash_attention` also returning the per-row log-sum-exp.
+
+    The (o, lse) pair is the mergeable partial-attention form: two
+    partials over disjoint key sets combine as
+
+        lse = logaddexp(lse1, lse2)
+        o   = o1 * exp(lse1 - lse) + o2 * exp(lse2 - lse)
+
+    which is what ring/context-parallel attention reduces over
+    (transformer/context_parallel.py). Differentiable in q/k/v with lse
+    cotangents folded into the fused backward.
+    """
+    return _fwd(
+        q, k, v, bias, causal,
+        scale if scale is not None else 1.0 / np.sqrt(q.shape[-1]),
+        block_q, block_k,
+    )
+
+
+def _fal_fwd(q, k, v, bias, causal, scale, block_q, block_k):
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    o, lse = _fwd(q, k, v, bias, causal, s, block_q, block_k)
+    return (o, lse), (q, k, v, bias, o, lse)
+
+
+def _fal_bwd(causal, scale, block_q, block_k, res, cot):
+    do, dlse = cot
+    s = scale if scale is not None else 1.0 / np.sqrt(res[0].shape[-1])
+    return _bwd(causal, s, block_q, block_k, res, do, dlse=dlse)
+
+
+flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
