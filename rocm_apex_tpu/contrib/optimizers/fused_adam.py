@@ -1,0 +1,71 @@
+"""Deprecated contrib FusedAdam (scale-aware step signature).
+
+Reference: apex/contrib/optimizers/fused_adam.py — the older fused
+Adam whose ``step(closure, grads, output_params, scale, grad_norms)``
+takes explicit grads and a loss scale, built for use with
+contrib.FP16_Optimizer. Kept as a shim over the modern
+`rocm_apex_tpu.optimizers.fused_adam` (the reference likewise marks it
+deprecated in favor of the core optimizer).
+"""
+
+import warnings
+from typing import Any, Optional, Tuple
+
+import optax
+
+from rocm_apex_tpu.optimizers import _common as c
+from rocm_apex_tpu.optimizers.fused_adam import fused_adam
+
+__all__ = ["FusedAdam"]
+
+
+class FusedAdam(c.FusedOptimizer):
+    """Deprecated scale-aware facade (reference contrib fused_adam.py:64:
+    `step(grads=…, scale=…)`)."""
+
+    def __init__(
+        self,
+        lr: c.ScalarOrSchedule = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        eps_inside_sqrt: bool = False,
+        weight_decay: float = 0.0,
+        max_grad_norm: float = 0.0,
+        amsgrad: bool = False,
+        use_mt: bool = False,
+        amp_scale_adjustment: float = 1.0,
+    ):
+        warnings.warn(
+            "contrib.optimizers.FusedAdam is deprecated — use "
+            "rocm_apex_tpu.optimizers.FusedAdam (reference deprecates it "
+            "identically)",
+            DeprecationWarning,
+        )
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        if eps_inside_sqrt:
+            raise NotImplementedError("eps_inside_sqrt is not supported")
+        del use_mt, amp_scale_adjustment, max_grad_norm
+        self._kw = dict(
+            bias_correction=bias_correction,
+            betas=betas,
+            eps=eps,
+            weight_decay=weight_decay,
+        )
+        self._lr = lr
+        super().__init__(fused_adam(lr, **self._kw))
+
+    def step_with_scale(self, params, grads, state, scale: float = 1.0,
+                        skip: Optional[Any] = None):
+        """The deprecated explicit-scale step: grads are divided by
+        `scale` inside the fused update."""
+        tx = fused_adam(self._lr, grad_scale=1.0 / scale, **self._kw)
+        updates, new_state = tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        if skip is None:
+            return new_params, new_state
+        return (
+            c.tree_where(skip, params, new_params),
+            c.tree_where(skip, state, new_state),
+        )

@@ -1,0 +1,77 @@
+"""multi_tensor_applier: the reference's kernel-glue entry point.
+
+Reference: apex/multi_tensor_apply/multi_tensor_apply.py:3-30 —
+``multi_tensor_applier(op, noop_flag_buffer, tensor_lists, *args)``
+dispatching chunked CUDA launches, with ``available`` set by the amp_C
+import. Here `op` is one of the packed-pytree ops from
+ops/multi_tensor.py (which subsume the chunking: one Pallas call over
+the whole packed set) and the noop flag is the returned overflow flag
+— carried functionally instead of written into a caller buffer.
+
+The op registry mirrors the amp_C pybind list
+(csrc/amp_C_frontend.cpp:147-174) where a TPU equivalent exists:
+    multi_tensor_scale, multi_tensor_axpby, multi_tensor_l2norm
+(the optimizer functors live behind rocm_apex_tpu.optimizers instead).
+"""
+
+from typing import Any, Sequence
+
+from rocm_apex_tpu.ops import multi_tensor as _mt
+
+__all__ = [
+    "multi_tensor_applier",
+    "MultiTensorApply",
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "available",
+]
+
+available = True  # no extension import to fail: Pallas ships in-tree
+
+
+def multi_tensor_scale(tensor_lists: Sequence[Any], scale):
+    """[src_list, dst_list] -> (dst_tree, overflow_flag)
+    (reference: csrc/multi_tensor_scale_kernel.cu semantics — dst dtype
+    follows the dst list; inf/nan sets the flag)."""
+    src, dst = tensor_lists
+    out_dtype = None
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(dst)
+    if leaves:
+        out_dtype = leaves[0].dtype
+    return _mt.scale(src, scale, out_dtype=out_dtype)
+
+
+def multi_tensor_axpby(tensor_lists: Sequence[Any], a, b):
+    """[x_list, y_list, out_list] -> (out_tree, overflow_flag)."""
+    x, y, _ = tensor_lists
+    return _mt.axpby(x, y, a, b)
+
+
+def multi_tensor_l2norm(tensor_lists: Sequence[Any], per_tensor: bool = False):
+    """[list] -> (global_norm, per_tensor_norms | None)
+    (reference: csrc/multi_tensor_l2norm_kernel.cu)."""
+    (xs,) = tensor_lists
+    return _mt.l2norm(xs, per_tensor=per_tensor)
+
+
+def multi_tensor_applier(op, noop_flag_buffer, tensor_lists, *args):
+    """Dispatch `op` over the tensor lists (reference signature kept;
+    `noop_flag_buffer` is ignored — the overflow flag is returned by
+    the op, chunk_size bookkeeping does not exist on TPU)."""
+    del noop_flag_buffer
+    return op(tensor_lists, *args)
+
+
+class MultiTensorApply:
+    """Class form (reference multi_tensor_apply.py:10-30)."""
+
+    available = True
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size  # accepted for parity; unused
+
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args):
+        return multi_tensor_applier(op, noop_flag_buffer, tensor_lists, *args)

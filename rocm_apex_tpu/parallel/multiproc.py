@@ -1,0 +1,33 @@
+"""Legacy one-process-per-device launcher (parity note).
+
+Reference: apex/parallel/multiproc.py:1-35 — forks one python process
+per GPU with RANK/WORLD_SIZE env vars for `torch.distributed`. JAX on
+TPU is single-controller per host: one process drives every local chip
+through the mesh, and multi-host programs launch via
+`jax.distributed.initialize` (the runtime reads the TPU topology — no
+rank bookkeeping to do here). `main()` therefore just execs the target
+script once and explains itself, keeping script compatibility for
+callers that invoked `python -m apex.parallel.multiproc train.py ...`.
+"""
+
+import runpy
+import sys
+
+__all__ = ["main"]
+
+
+def main():
+    print(
+        "rocm_apex_tpu.parallel.multiproc: single-controller JAX drives all "
+        "local devices from one process; running the target inline. For "
+        "multi-host, call jax.distributed.initialize() in your script."
+    )
+    if len(sys.argv) < 2:
+        raise SystemExit("usage: python -m rocm_apex_tpu.parallel.multiproc script.py [args...]")
+    script = sys.argv[1]
+    sys.argv = sys.argv[1:]
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Per-module logging helpers.
+
+Reference: apex/transformer/log_util.py — `get_transformer_logger` with
+env-controlled level, plus `set_logging_level`.
+"""
+
+import logging
+import os
+
+__all__ = ["get_transformer_logger", "set_logging_level"]
+
+_ENV = "APEX_TPU_LOG_LEVEL"
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    name = name.rsplit(".", 1)[-1]
+    logger = logging.getLogger(f"rocm_apex_tpu.transformer.{name}")
+    level = os.environ.get(_ENV)
+    if level:
+        logger.setLevel(level.upper())
+    return logger
+
+
+def set_logging_level(verbosity) -> None:
+    logging.getLogger("rocm_apex_tpu.transformer").setLevel(verbosity)

@@ -1,0 +1,124 @@
+"""Checkpoint manager, multi_tensor_applier facade, misc parity shims."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_apex_tpu.checkpoint import (
+    CheckpointManager,
+    restore_pytree,
+    save_pytree,
+)
+from rocm_apex_tpu.multi_tensor_apply import (
+    MultiTensorApply,
+    multi_tensor_applier,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        tree = {
+            "w": jnp.arange(12.0).reshape(3, 4),
+            "count": jnp.asarray(7, jnp.int32),
+        }
+        p = str(tmp_path / "ckpt1")
+        save_pytree(p, tree)
+        back = restore_pytree(p, template=tree)
+        np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(tree["w"]))
+        assert int(back["count"]) == 7
+
+    def test_manager_retention_and_resume(self, tmp_path):
+        mgr = CheckpointManager(
+            str(tmp_path / "run"), max_to_keep=2,
+            install_sigterm_handler=False,
+        )
+        assert mgr.latest_step() is None
+        state = {"x": jnp.ones((4,))}
+        # restore_or falls through to init when empty
+        got = mgr.restore_or(lambda: state)
+        np.testing.assert_array_equal(np.asarray(got["x"]), 1.0)
+        for step in [1, 2, 3]:
+            mgr.save(step, {"x": jnp.full((4,), float(step))}, force=True)
+        assert mgr.latest_step() == 3
+        back = mgr.restore(template={"x": jnp.zeros((4,))})
+        np.testing.assert_array_equal(np.asarray(back["x"]), 3.0)
+        # retention pruned step 1
+        steps = list(mgr._mgr.all_steps())
+        assert 1 not in steps and len(steps) <= 2
+        # resume path
+        resumed = mgr.restore_or(lambda: state, template={"x": jnp.zeros((4,))})
+        np.testing.assert_array_equal(np.asarray(resumed["x"]), 3.0)
+        mgr.close()
+
+    def test_should_exit_flag(self, tmp_path):
+        mgr = CheckpointManager(
+            str(tmp_path / "run2"), install_sigterm_handler=False
+        )
+        assert not mgr.should_exit()
+        mgr._exit.set()
+        assert mgr.should_exit()
+        mgr.close()
+
+
+class TestMultiTensorApplier:
+    def test_scale(self):
+        src = {"a": jnp.ones((8,)), "b": jnp.full((4,), 2.0)}
+        dst = jax.tree_util.tree_map(jnp.zeros_like, src)
+        out, flag = multi_tensor_applier(
+            multi_tensor_scale, None, [src, dst], 0.5
+        )
+        np.testing.assert_array_equal(np.asarray(out["a"]), 0.5)
+        np.testing.assert_array_equal(np.asarray(out["b"]), 1.0)
+        assert not bool(flag)
+
+    def test_scale_overflow_flag(self):
+        src = {"a": jnp.asarray([1.0, jnp.inf])}
+        out, flag = multi_tensor_scale([src, src], 1.0)
+        assert bool(flag)
+
+    def test_axpby(self):
+        x = {"a": jnp.ones((4,))}
+        y = {"a": jnp.full((4,), 3.0)}
+        out, flag = multi_tensor_axpby([x, y, x], 2.0, 1.0)
+        np.testing.assert_array_equal(np.asarray(out["a"]), 5.0)
+
+    def test_l2norm(self):
+        xs = {"a": jnp.full((4,), 2.0)}  # ||x|| = 4
+        gnorm, per = multi_tensor_l2norm([xs], False)
+        np.testing.assert_allclose(float(gnorm), 4.0, rtol=1e-6)
+
+    def test_class_form(self):
+        mta = MultiTensorApply(2048 * 32)
+        assert mta.available
+        x = {"a": jnp.ones((2,))}
+        out, _ = mta(multi_tensor_scale, None, [x, x], 2.0)
+        np.testing.assert_array_equal(np.asarray(out["a"]), 2.0)
+
+
+class TestDeprecatedContribAdam:
+    def test_scale_aware_step(self):
+        with pytest.warns(DeprecationWarning):
+            from rocm_apex_tpu.contrib.optimizers.fused_adam import FusedAdam
+
+            opt = FusedAdam(lr=1e-2)
+        params = {"w": jnp.ones((4,))}
+        state = opt.init(params)
+        grads = {"w": jnp.full((4,), 256.0)}  # scaled by 256
+        p1, _ = opt.step_with_scale(params, grads, state, scale=256.0)
+        # equals an unscaled step with grads=1
+        from rocm_apex_tpu.optimizers import fused_adam as modern
+        import optax
+
+        tx = modern(1e-2)
+        u, _ = tx.update({"w": jnp.ones((4,))}, tx.init(params), params)
+        p2 = optax.apply_updates(params, u)
+        np.testing.assert_allclose(
+            np.asarray(p1["w"]), np.asarray(p2["w"]), rtol=1e-6
+        )
