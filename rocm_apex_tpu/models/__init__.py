@@ -22,6 +22,8 @@ from rocm_apex_tpu.models.gpt import (  # noqa: F401
 from rocm_apex_tpu.models.bert import BertConfig, BertModel  # noqa: F401
 from rocm_apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401
 from rocm_apex_tpu.models.resnet import (  # noqa: F401
+    BasicBlock,
+    Bottleneck,
     ResNet,
     resnet18,
     resnet34,

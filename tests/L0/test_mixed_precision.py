@@ -104,3 +104,33 @@ class TestMixedPrecisionAdam:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-6
             )
+
+
+class TestStepAndProbe:
+    def test_matches_probe_then_step(self):
+        """step_and_probe == all_finite probe + step(skip=...) for both
+        clean and poisoned grads."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from rocm_apex_tpu.amp import all_finite
+
+        params = make_params(jax.random.PRNGKey(4))
+        opt = MixedPrecisionAdam(1e-2, weight_decay=0.01)
+        for poison in [False, True]:
+            g = jax.tree_util.tree_map(
+                lambda x: jnp.ones_like(x, jnp.bfloat16) * 0.5, params
+            )
+            if poison:
+                g = {**g, "w": g["w"].at[0, 0].set(jnp.inf)}
+            s0 = opt.init(params)
+            s1, found = opt.step_and_probe(s0, g, grad_scale=0.5)
+            assert bool(found) == poison
+            fi = ~all_finite(g)
+            s2 = opt.step(s0, g, grad_scale=0.5, skip=fi)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(s1.master),
+                jax.tree_util.tree_leaves(s2.master),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert int(s1.count) == int(s2.count)

@@ -81,8 +81,15 @@ class TestResNet:
         from jax.sharding import Mesh, PartitionSpec as P
         from jax.experimental.shard_map import shard_map
 
+        from rocm_apex_tpu.models import ResNet, BasicBlock
+
         mesh = Mesh(np.array(eight_devices[:4]), ("data",))
-        m = resnet18(num_classes=4, sync_bn_axis="data")
+        # two tiny stages: the SyncBN-in-ResNet path without the 300s
+        # full-RN18 CPU-mesh compile
+        m = ResNet(
+            stage_sizes=(1, 1), block=BasicBlock, num_filters=8,
+            num_classes=4, sync_bn_axis="data",
+        )
         x = jax.random.normal(jax.random.PRNGKey(3), (8, 32, 32, 3))
 
         def local(x):
