@@ -47,6 +47,15 @@ via `forward_step_func`):
     returns (per_microbatch_losses, grads) — grads of mean loss w.r.t.
     params (None when forward_only), loss replicated on every stage.
 
+Shared non-stage parameters (the reference's pre_process/post_process
+stages: embedding on the first stage, tied LM head on the last —
+schedules/common.py build_model) ride the optional ``extra_params`` /
+``pre_fn`` arguments: ``pre_fn(extra, microbatch_input)`` produces the
+stage-0 activation (embedding lookup) and ``loss_fn`` becomes
+``loss_fn(extra, y_last, target)`` (head + loss). The return value is
+then ``(losses, (stage_grads, extra_grads))`` with extra grads summed
+over the pipe axis — the reference's embedding-group allreduce.
+
 Pipelined schedules must run inside shard_map with the ``pipe`` axis
 bound; `forward_backward_no_pipelining` runs anywhere.
 """
@@ -58,6 +67,30 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.transformer import parallel_state
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _replicate_masked(x, maskf, axis):
+    """Broadcast masked values across the axis: out = psum(x * maskf).
+
+    Explicit VJP because the raw psum's transpose depends on shard_map
+    replication tracking: with check_rep=False it degenerates to a psum
+    of cotangents and every gradient through the loss replication comes
+    back axis-size times too large. The true transpose of "replicate
+    from the masked rank" keeps the cotangent only where the mask is
+    set — correct under either check_rep setting."""
+    return jax.lax.psum(x * maskf, axis)
+
+
+def _replicate_masked_fwd(x, maskf, axis):
+    return jax.lax.psum(x * maskf, axis), maskf
+
+
+def _replicate_masked_bwd(axis, maskf, ct):
+    return (ct * maskf, jnp.zeros_like(maskf))
+
+
+_replicate_masked.defvjp(_replicate_masked_fwd, _replicate_masked_bwd)
 
 __all__ = [
     "get_forward_backward_func",
@@ -100,6 +133,8 @@ def forward_backward_no_pipelining(
     forward_only: bool = False,
     checkpoint_stages: bool = False,
     axis_name: Optional[str] = None,
+    extra_params: Any = None,
+    pre_fn=None,
     **unused_kw,
 ):
     """Sequential microbatch loop with gradient accumulation.
@@ -114,24 +149,49 @@ def forward_backward_no_pipelining(
     del axis_name
     m = inputs.shape[0]
     body = _maybe_checkpoint(stage_fn, checkpoint_stages)
+    has_extra = extra_params is not None
 
-    def one_loss(p, x, t):
-        return loss_fn(body(p, x), t)
+    def one_loss(p, extra, x, t):
+        x0 = pre_fn(extra, x) if pre_fn is not None else x
+        y = body(p, x0)
+        return loss_fn(extra, y, t) if has_extra else loss_fn(y, t)
 
     if forward_only:
-        losses = jax.lax.map(lambda xt: one_loss(params, xt[0], xt[1]), (inputs, targets))
+        losses = jax.lax.map(
+            lambda xt: one_loss(params, extra_params, xt[0], xt[1]),
+            (inputs, targets),
+        )
         return losses, None
+
+    argnums = (0, 1) if has_extra else 0
 
     def step(acc, xt):
         x, t = xt
-        loss, g = jax.value_and_grad(one_loss)(params, x, t)
-        acc = jax.tree_util.tree_map(lambda a, b: a + b / m, acc, g)
-        return acc, loss
+        accp, acce = acc
+        loss, g_all = jax.value_and_grad(one_loss, argnums=argnums)(
+            params, extra_params, x, t
+        )
+        g, ge = g_all if has_extra else (g_all, None)
+        accp = jax.tree_util.tree_map(lambda a, b: a + b / m, accp, g)
+        if has_extra:
+            acce = jax.tree_util.tree_map(lambda a, b: a + b / m, acce, ge)
+        return (accp, acce), loss
 
     zero = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params
     )
-    grads, losses = jax.lax.scan(step, zero, (inputs, targets))
+    zero_e = (
+        jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), extra_params
+        )
+        if has_extra
+        else None
+    )
+    (grads, egrads), losses = jax.lax.scan(
+        step, (zero, zero_e), (inputs, targets)
+    )
+    if has_extra:
+        return losses, (grads, egrads)
     return losses, grads
 
 
@@ -145,6 +205,8 @@ def forward_backward_pipelining_without_interleaving(
     forward_only: bool = False,
     checkpoint_stages: bool = True,
     axis_name: Optional[str] = None,
+    extra_params: Any = None,
+    pre_fn=None,
     **unused_kw,
 ):
     """The 1F1B-equivalent linear pipeline.
@@ -169,38 +231,74 @@ def forward_backward_pipelining_without_interleaving(
     local_params = jax.tree_util.tree_map(
         lambda x: jnp.squeeze(x, 0) if x.shape[:1] == (1,) else x, params
     )
+    has_extra = extra_params is not None
 
-    def run(local_params):
+    def run(local_params, extra):
         def tick(carry, t):
             act_recv, loss_buf = carry
             mb_in = jnp.clip(t, 0, m - 1)
-            x = jnp.where(is_first, inputs[mb_in], act_recv)
+            # pre_fn = the reference's pre_process stage-0 work
+            # (embedding; schedules/common.py build_model pre_process).
+            # SPMD computes it on every rank; only stage 0 consumes it,
+            # so its gradient contributions vanish elsewhere.
+            x_in = inputs[mb_in]
+            x0 = pre_fn(extra, x_in) if pre_fn is not None else x_in
+            x = jnp.where(is_first, x0, act_recv)
             y = body(local_params, x)
             # Output collection on the last stage: tick t completes
             # microbatch t-(P-1).
             mb_out = t - (p - 1)
             valid = (mb_out >= 0) & is_last
             mb_out_c = jnp.clip(mb_out, 0, m - 1)
-            mb_loss = loss_fn(y, jax.tree_util.tree_map(lambda v: v[mb_out_c], targets))
+            tgt = jax.tree_util.tree_map(lambda v: v[mb_out_c], targets)
+            # post_process: extra-aware loss (LM head, CE)
+            mb_loss = loss_fn(extra, y, tgt) if has_extra else loss_fn(y, tgt)
+            # gate the loss with a multiplicative mask so cotangents of
+            # invalid ticks vanish instead of flowing into stale state
             loss_buf = loss_buf.at[mb_out_c].set(
                 jnp.where(valid, mb_loss.astype(jnp.float32), loss_buf[mb_out_c])
             )
             sent = jax.lax.ppermute(y, axis, perm)
             return (sent, loss_buf), None
 
-        act0 = jax.lax.pcast(jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying')
+        if pre_fn is not None:
+            a0 = jax.eval_shape(pre_fn, extra, inputs[0])
+            act0 = jax.lax.pcast(
+                jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
+            )
+        else:
+            act0 = jax.lax.pcast(
+                jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying'
+            )
         loss0 = jax.lax.pcast(jnp.zeros((m,), jnp.float32), (axis,), to='varying')
         (_, loss_buf), _ = jax.lax.scan(tick, (act0, loss0), jnp.arange(ticks))
         # Replicate the last stage's losses to every stage so the caller
         # sees one logical value (reference keeps losses on the last
         # stage only and broadcasts out-of-band).
-        loss_buf = jax.lax.psum(jnp.where(is_last, loss_buf, 0.0), axis)
+        loss_buf = _replicate_masked(
+            loss_buf, is_last.astype(loss_buf.dtype), axis
+        )
         return jnp.mean(loss_buf), loss_buf
 
     if forward_only:
-        _, losses = run(local_params)
+        _, losses = run(local_params, extra_params)
         return losses, None
-    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(local_params)
+    if has_extra:
+        (_, losses), (grads, egrads) = jax.value_and_grad(
+            run, argnums=(0, 1), has_aux=True
+        )(local_params, extra_params)
+        # Shared-param grads are per-stage partials (stage 0 holds the
+        # pre_fn/embedding path, stage P-1 the loss-head path): sum over
+        # the axis — the reference's embedding-group allreduce
+        # (parallel_state embedding group = first + last stage).
+        egrads = jax.lax.psum(egrads, axis)
+        grads = jax.tree_util.tree_map(
+            lambda g, x: g[None] if x.shape[:1] == (1,) else g, grads, params
+        )
+        return losses, (grads, egrads)
+    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(
+        local_params, extra_params
+    )
     grads = jax.tree_util.tree_map(
         lambda g, x: g[None] if x.shape[:1] == (1,) else g, grads, params
     )
@@ -217,6 +315,8 @@ def forward_backward_pipelining_with_interleaving(
     forward_only: bool = False,
     checkpoint_stages: bool = True,
     axis_name: Optional[str] = None,
+    extra_params: Any = None,
+    pre_fn=None,
     **unused_kw,
 ):
     """Interleaved virtual stages as a circular pipeline.
@@ -258,7 +358,9 @@ def forward_backward_pipelining_with_interleaving(
     ring = [(i, (i + 1) % p) for i in range(p)]
     round_len = p * vp
 
-    def run(params):
+    has_extra = extra_params is not None
+
+    def run(params, extra):
         def tick(carry, t):
             act_recv, loss_buf = carry
             r = t - rank
@@ -273,26 +375,45 @@ def forward_backward_pipelining_with_interleaving(
                 params,
             )
             is_entry = (rank == 0) & (v_c == 0)
-            x = jnp.where(is_entry, inputs[mb_c], act_recv)
+            x_in = inputs[mb_c]
+            x0 = pre_fn(extra, x_in) if pre_fn is not None else x_in
+            x = jnp.where(is_entry, x0, act_recv)
             y = body(chunk, x)
             is_exit = (rank == p - 1) & (v_c == vp - 1) & valid
-            mb_loss = loss_fn(y, jax.tree_util.tree_map(lambda q: q[mb_c], targets))
+            tgt = jax.tree_util.tree_map(lambda q: q[mb_c], targets)
+            mb_loss = loss_fn(extra, y, tgt) if has_extra else loss_fn(y, tgt)
             loss_buf = loss_buf.at[mb_c].set(
                 jnp.where(is_exit, mb_loss.astype(jnp.float32), loss_buf[mb_c])
             )
             sent = jax.lax.ppermute(y, axis, ring)
             return (sent, loss_buf), None
 
-        act0 = jax.lax.pcast(jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying')
+        if pre_fn is not None:
+            a0 = jax.eval_shape(pre_fn, extra, inputs[0])
+            act0 = jax.lax.pcast(
+                jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
+            )
+        else:
+            act0 = jax.lax.pcast(
+                jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying'
+            )
         loss0 = jax.lax.pcast(jnp.zeros((m,), jnp.float32), (axis,), to='varying')
         (_, loss_buf), _ = jax.lax.scan(tick, (act0, loss0), jnp.arange(ticks))
-        loss_buf = jax.lax.psum(
-            jnp.where(rank == p - 1, loss_buf, 0.0), axis
+        loss_buf = _replicate_masked(
+            loss_buf, (rank == p - 1).astype(loss_buf.dtype), axis
         )
         return jnp.mean(loss_buf), loss_buf
 
     if forward_only:
-        _, losses = run(params)
+        _, losses = run(params, extra_params)
         return losses, None
-    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(params)
+    if has_extra:
+        (_, losses), (grads, egrads) = jax.value_and_grad(
+            run, argnums=(0, 1), has_aux=True
+        )(params, extra_params)
+        egrads = jax.lax.psum(egrads, axis)
+        return losses, (grads, egrads)
+    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(
+        params, extra_params
+    )
     return losses, grads

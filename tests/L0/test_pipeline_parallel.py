@@ -432,3 +432,109 @@ class TestPipelineUtils:
         assert not mask[0, 0, 3, 2]
         # token 5 (doc 3) must not attend to anything before it
         assert mask[0, 0, 5, 4] and not mask[0, 0, 5, 5]
+
+
+class TestPipelineWithEmbedding:
+    """Full-model pipelining: embedding (pre_fn) and tied LM head
+    (extra-aware loss) trained THROUGH the pipeline — the reference's
+    pre_process/post_process stages + embedding-group grad allreduce
+    (schedules/common.py build_model, parallel_state embedding group).
+    Bar: losses and ALL grads match the serial unpipelined model."""
+
+    def test_gpt_pipeline_matches_serial(self, eight_devices):
+        from rocm_apex_tpu.models.gpt import (
+            GPTConfig,
+            ParallelTransformerLayer,
+            TransformerEmbedding,
+            _serial_cross_entropy,
+        )
+
+        cfg = GPTConfig(
+            vocab_size=64,
+            hidden_size=32,
+            num_layers=PP,
+            num_attention_heads=2,
+            max_position_embeddings=16,
+            hidden_dropout=0.0,
+            attention_dropout=0.0,
+            tensor_parallel_size=1,
+            params_dtype=jnp.float32,
+            dtype=jnp.float32,
+            attention_impl="jnp",
+            use_pallas_softmax=False,
+        )
+        emb = TransformerEmbedding(cfg)
+        layer = ParallelTransformerLayer(cfg)
+        mb, seq = 2, 16
+        key = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(key, (M, mb, seq), 0, cfg.vocab_size)
+        labels = jnp.roll(tokens, -1, axis=-1)
+
+        tok0 = tokens[0]
+        e_params = emb.init(jax.random.PRNGKey(1), tok0)
+        x0 = emb.apply(e_params, tok0)
+        l_params = [
+            layer.init(jax.random.fold_in(jax.random.PRNGKey(2), i), x0)
+            for i in range(PP)
+        ]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *l_params)
+
+        def pre_fn(extra, tok):
+            return emb.apply(extra, tok)
+
+        def stage(p, x):
+            return layer.apply(p, x)
+
+        def loss_with_head(extra, y, tgt):
+            logits = emb.apply(extra, y, method=TransformerEmbedding.attend)
+            return jnp.mean(_serial_cross_entropy(logits, tgt))
+
+        mesh = pipe_mesh(eight_devices)
+        # check_rep=False is safe: the schedule's loss replication has
+        # an explicit VJP (schedules._replicate_masked), so gradients do
+        # not depend on shard_map's replication tracking
+        f = shard_map(
+            lambda p, e, x, t: forward_backward_pipelining_without_interleaving(
+                stage, loss_with_head, p, x, t,
+                axis_name="pipe", extra_params=e, pre_fn=pre_fn,
+            ),
+            mesh=mesh,
+            in_specs=(P("pipe"), P(), P(), P()),
+            out_specs=(P(), (P("pipe"), P())),
+            check_rep=False,
+        )
+        losses, (lgrads, egrads) = jax.jit(f)(stacked, e_params, tokens, labels)
+
+        # serial reference
+        def total_loss(lp, ep):
+            def one(tok, tgt):
+                x = emb.apply(ep, tok)
+                for s in range(PP):
+                    x = layer.apply(
+                        jax.tree_util.tree_map(lambda v: v[s], lp), x
+                    )
+                logits = emb.apply(ep, x, method=TransformerEmbedding.attend)
+                return jnp.mean(_serial_cross_entropy(logits, tgt))
+
+            losses = jax.vmap(one)(tokens, labels)
+            return jnp.mean(losses), losses
+
+        (_, exp_losses), (exp_l, exp_e) = jax.value_and_grad(
+            total_loss, argnums=(0, 1), has_aux=True
+        )(stacked, e_params)
+
+        np.testing.assert_allclose(
+            np.asarray(losses), np.asarray(exp_losses), rtol=1e-5, atol=1e-6
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(lgrads), jax.tree_util.tree_leaves(exp_l)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(egrads), jax.tree_util.tree_leaves(exp_e)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
