@@ -92,6 +92,46 @@ def _replicate_masked_bwd(axis, maskf, ct):
 
 _replicate_masked.defvjp(_replicate_masked_fwd, _replicate_masked_bwd)
 
+
+def _pcast_varying(x, axis):
+    """Make `x` varying over `axis` by adding a varying zero.
+
+    Idempotent, and — unlike a raw `pcast(to='varying')`, whose
+    transpose is a psum over the axis — the add's transpose passes the
+    cotangent through per-rank, so no hidden collective appears in the
+    backward (the schedules do their cross-stage grad sums explicitly)."""
+    z = jax.lax.pcast(
+        jnp.zeros((), jnp.result_type(x)), (axis,), to='varying'
+    )
+    return x + z
+
+
+def _stage0_inputs(pre_fn, extra, inputs, axis):
+    """(M, ...) stage-0 activations: every microbatch embedded ONCE
+    before the scan (instead of once per tick inside it). SPMD runs the
+    embedding on every rank; only stage 0 consumes the result, and the
+    unused copies carry zero cotangents through the stage-0 select."""
+    if pre_fn is None:
+        return inputs, jax.eval_shape(lambda x: x[0], inputs)
+    x0_all = _pcast_varying(
+        jax.vmap(lambda xi: pre_fn(extra, xi))(inputs), axis
+    )
+    return x0_all, jax.eval_shape(lambda x: x[0], x0_all)
+
+
+def _head_losses(loss_fn, has_extra, extra, y_buf, targets, axis):
+    """(M,) per-microbatch losses: the post_process head applied ONCE
+    per microbatch after the scan (not per tick). Non-exit ranks run it
+    on their zero y_buf; the masked replicate downstream discards the
+    values and zeroes the cotangents."""
+
+    def one(y, t):
+        loss = loss_fn(extra, y, t) if has_extra else loss_fn(y, t)
+        return loss.astype(jnp.float32)
+
+    return _pcast_varying(jax.vmap(one)(y_buf, targets), axis)
+
+
 __all__ = [
     "get_forward_backward_func",
     "forward_backward_no_pipelining",
@@ -234,44 +274,38 @@ def forward_backward_pipelining_without_interleaving(
     has_extra = extra_params is not None
 
     def run(local_params, extra):
+        # pre_process: every microbatch embedded once, on stage 0 only
+        x0_all, a0 = _stage0_inputs(pre_fn, extra, inputs, axis)
+
         def tick(carry, t):
-            act_recv, loss_buf = carry
+            act_recv, y_buf = carry
             mb_in = jnp.clip(t, 0, m - 1)
-            # pre_fn = the reference's pre_process stage-0 work
-            # (embedding; schedules/common.py build_model pre_process).
-            # SPMD computes it on every rank; only stage 0 consumes it,
-            # so its gradient contributions vanish elsewhere.
-            x_in = inputs[mb_in]
-            x0 = pre_fn(extra, x_in) if pre_fn is not None else x_in
-            x = jnp.where(is_first, x0, act_recv)
+            x = jnp.where(is_first, x0_all[mb_in], act_recv)
             y = body(local_params, x)
             # Output collection on the last stage: tick t completes
-            # microbatch t-(P-1).
+            # microbatch t-(P-1). The head/loss is NOT applied here —
+            # outputs buffer up and post_process runs once after the
+            # scan (the where gates cotangents of invalid ticks to zero)
             mb_out = t - (p - 1)
             valid = (mb_out >= 0) & is_last
             mb_out_c = jnp.clip(mb_out, 0, m - 1)
-            tgt = jax.tree_util.tree_map(lambda v: v[mb_out_c], targets)
-            # post_process: extra-aware loss (LM head, CE)
-            mb_loss = loss_fn(extra, y, tgt) if has_extra else loss_fn(y, tgt)
-            # gate the loss with a multiplicative mask so cotangents of
-            # invalid ticks vanish instead of flowing into stale state
-            loss_buf = loss_buf.at[mb_out_c].set(
-                jnp.where(valid, mb_loss.astype(jnp.float32), loss_buf[mb_out_c])
+            y_buf = y_buf.at[mb_out_c].set(
+                jnp.where(valid, y, y_buf[mb_out_c])
             )
             sent = jax.lax.ppermute(y, axis, perm)
-            return (sent, loss_buf), None
+            return (sent, y_buf), None
 
-        if pre_fn is not None:
-            a0 = jax.eval_shape(pre_fn, extra, inputs[0])
-            act0 = jax.lax.pcast(
-                jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
-            )
-        else:
-            act0 = jax.lax.pcast(
-                jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying'
-            )
-        loss0 = jax.lax.pcast(jnp.zeros((m,), jnp.float32), (axis,), to='varying')
-        (_, loss_buf), _ = jax.lax.scan(tick, (act0, loss0), jnp.arange(ticks))
+        act0 = jax.lax.pcast(
+            jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
+        )
+        ybuf0 = jax.lax.pcast(
+            jnp.zeros((m,) + a0.shape, a0.dtype), (axis,), to='varying'
+        )
+        (_, y_buf), _ = jax.lax.scan(tick, (act0, ybuf0), jnp.arange(ticks))
+        # post_process on the last stage, once per microbatch
+        loss_buf = _head_losses(
+            loss_fn, has_extra, extra, y_buf, targets, axis
+        )
         # Replicate the last stage's losses to every stage so the caller
         # sees one logical value (reference keeps losses on the last
         # stage only and broadcasts out-of-band).
@@ -291,7 +325,12 @@ def forward_backward_pipelining_without_interleaving(
         # pre_fn/embedding path, stage P-1 the loss-head path): sum over
         # the axis — the reference's embedding-group allreduce
         # (parallel_state embedding group = first + last stage).
-        egrads = jax.lax.psum(egrads, axis)
+        egrads = jax.lax.psum(
+            jax.tree_util.tree_map(
+                lambda g: _pcast_varying(g, axis), egrads
+            ),
+            axis,
+        )
         grads = jax.tree_util.tree_map(
             lambda g, x: g[None] if x.shape[:1] == (1,) else g, grads, params
         )
@@ -359,10 +398,14 @@ def forward_backward_pipelining_with_interleaving(
     round_len = p * vp
 
     has_extra = extra_params is not None
+    is_first = rank == 0
+    is_last = rank == p - 1
 
     def run(params, extra):
+        x0_all, a0 = _stage0_inputs(pre_fn, extra, inputs, axis)
+
         def tick(carry, t):
-            act_recv, loss_buf = carry
+            act_recv, y_buf = carry
             r = t - rank
             rnd, rr = r // round_len, r % round_len
             v = rr // p
@@ -374,33 +417,26 @@ def forward_backward_pipelining_with_interleaving(
                 lambda x: jax.lax.dynamic_index_in_dim(x, v_c, 0, keepdims=False),
                 params,
             )
-            is_entry = (rank == 0) & (v_c == 0)
-            x_in = inputs[mb_c]
-            x0 = pre_fn(extra, x_in) if pre_fn is not None else x_in
-            x = jnp.where(is_entry, x0, act_recv)
+            is_entry = is_first & (v_c == 0)
+            x = jnp.where(is_entry, x0_all[mb_c], act_recv)
             y = body(chunk, x)
-            is_exit = (rank == p - 1) & (v_c == vp - 1) & valid
-            tgt = jax.tree_util.tree_map(lambda q: q[mb_c], targets)
-            mb_loss = loss_fn(extra, y, tgt) if has_extra else loss_fn(y, tgt)
-            loss_buf = loss_buf.at[mb_c].set(
-                jnp.where(is_exit, mb_loss.astype(jnp.float32), loss_buf[mb_c])
-            )
+            is_exit = is_last & (v_c == vp - 1) & valid
+            y_buf = y_buf.at[mb_c].set(jnp.where(is_exit, y, y_buf[mb_c]))
             sent = jax.lax.ppermute(y, axis, ring)
-            return (sent, loss_buf), None
+            return (sent, y_buf), None
 
-        if pre_fn is not None:
-            a0 = jax.eval_shape(pre_fn, extra, inputs[0])
-            act0 = jax.lax.pcast(
-                jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
-            )
-        else:
-            act0 = jax.lax.pcast(
-                jnp.zeros(inputs.shape[1:], inputs.dtype), (axis,), to='varying'
-            )
-        loss0 = jax.lax.pcast(jnp.zeros((m,), jnp.float32), (axis,), to='varying')
-        (_, loss_buf), _ = jax.lax.scan(tick, (act0, loss0), jnp.arange(ticks))
+        act0 = jax.lax.pcast(
+            jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
+        )
+        ybuf0 = jax.lax.pcast(
+            jnp.zeros((m,) + a0.shape, a0.dtype), (axis,), to='varying'
+        )
+        (_, y_buf), _ = jax.lax.scan(tick, (act0, ybuf0), jnp.arange(ticks))
+        loss_buf = _head_losses(
+            loss_fn, has_extra, extra, y_buf, targets, axis
+        )
         loss_buf = _replicate_masked(
-            loss_buf, (rank == p - 1).astype(loss_buf.dtype), axis
+            loss_buf, is_last.astype(loss_buf.dtype), axis
         )
         return jnp.mean(loss_buf), loss_buf
 
@@ -411,7 +447,12 @@ def forward_backward_pipelining_with_interleaving(
         (_, losses), (grads, egrads) = jax.value_and_grad(
             run, argnums=(0, 1), has_aux=True
         )(params, extra_params)
-        egrads = jax.lax.psum(egrads, axis)
+        egrads = jax.lax.psum(
+            jax.tree_util.tree_map(
+                lambda g: _pcast_varying(g, axis), egrads
+            ),
+            axis,
+        )
         return losses, (grads, egrads)
     (_, losses), grads = jax.value_and_grad(run, has_aux=True)(
         params, extra_params
