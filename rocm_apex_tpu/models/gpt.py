@@ -56,6 +56,7 @@ __all__ = [
     "ParallelTransformer",
     "TransformerEmbedding",
     "gpt_loss_fn",
+    "gpt_pipeline_functions",
 ]
 
 
@@ -447,3 +448,42 @@ def gpt_loss_fn(losses, loss_mask=None):
     if loss_mask is not None:
         return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
     return jnp.mean(losses)
+
+
+def gpt_pipeline_functions(cfg: GPTConfig):
+    """(embedding, layer, pre_fn, loss_fn) for the pipeline schedules.
+
+    The full GPT split the way the reference's build_model does
+    (schedules/common.py:18-106): embedding on the entry stage
+    (``pre_fn``), a uniform `ParallelTransformerLayer` as the stage
+    body, and the tied LM head + CE as the extra-aware ``loss_fn`` on
+    the exit stage. Use with
+    `forward_backward_pipelining_without_interleaving(stage_fn, loss_fn,
+    stacked_layer_params, tokens_microbatched, labels_microbatched,
+    extra_params=embedding_params, pre_fn=pre_fn)`.
+    """
+    embedding = TransformerEmbedding(cfg)
+    layer = ParallelTransformerLayer(cfg)
+
+    def pre_fn(extra, tokens):
+        return embedding.apply(extra, tokens)
+
+    def stage_fn(stage_params, x):
+        return layer.apply(stage_params, x)
+
+    def loss_fn(extra, hidden, labels):
+        logits = embedding.apply(
+            extra, hidden, method=TransformerEmbedding.attend
+        )
+        tp = cfg.tensor_parallel_size or 1
+        if tp > 1:
+            losses = vocab_parallel_cross_entropy(
+                logits.astype(jnp.float32), labels, cfg.tensor_axis
+            )
+        else:
+            losses = _serial_cross_entropy(
+                logits.astype(jnp.float32), labels
+            )
+        return jnp.mean(losses)
+
+    return embedding, layer, pre_fn, stage_fn, loss_fn
