@@ -69,3 +69,29 @@ def _get_logger():
 
 
 logger = _get_logger()
+
+
+# Lazy subpackage access (PEP 562): `import rocm_apex_tpu` then
+# `rocm_apex_tpu.amp` works like the reference's `import apex` →
+# `apex.amp` (apex/__init__.py imports them eagerly; lazy here keeps
+# the base import free of jax-graph construction).
+_SUBPACKAGES = {
+    "amp", "optimizers", "parallel", "transformer", "normalization",
+    "mlp", "fused_dense", "fp16_utils", "RNN", "reparameterization",
+    "contrib", "models", "ops", "profiler", "checkpoint",
+    "multi_tensor_apply", "utils",
+}
+
+
+def __getattr__(name):
+    if name in _SUBPACKAGES:
+        import importlib
+
+        module = importlib.import_module(f"{__name__}.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | _SUBPACKAGES)
