@@ -92,6 +92,12 @@ class GPTConfig:
     # tensor_parallel/random.py:224-293 CheckpointFunction; here it is
     # jax.checkpoint/remat — RNG replay is free with functional PRNG)
     checkpoint_activations: bool = False
+    # sequence/context parallelism (capability beyond the reference):
+    # when set to a bound mesh axis name, the model runs on LOCAL
+    # sequence shards — causal attention becomes ring flash attention
+    # over the axis and position embeddings offset by the shard start.
+    # Requires attention_impl="flash" and contiguous axis-order sharding.
+    context_parallel_axis: Optional[str] = None
 
     @property
     def ffn_size(self) -> int:
@@ -201,7 +207,17 @@ class ParallelAttention(nn.Module):
             kf = k.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
             vf = v.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
             if self.attn_mask_type == "causal":
-                ctxf = flash_attention(qf, kf, vf, None, True, scale)
+                if cfg.context_parallel_axis is not None:
+                    from rocm_apex_tpu.transformer.context_parallel import (
+                        ring_flash_attention,
+                    )
+
+                    ctxf = ring_flash_attention(
+                        qf, kf, vf, cfg.context_parallel_axis,
+                        causal=True, scale=scale,
+                    )
+                else:
+                    ctxf = flash_attention(qf, kf, vf, None, True, scale)
             else:
                 if attention_mask is None:
                     raise ValueError("padding attention needs attention_mask")
@@ -374,6 +390,13 @@ class TransformerEmbedding(nn.Module):
         words = self.word_embeddings(tokens)
         if position_ids is None:
             position_ids = jnp.arange(tokens.shape[1])[None, :]
+            if cfg.context_parallel_axis is not None:
+                # local shard of the sequence: offset by the shard start
+                start = (
+                    jax.lax.axis_index(cfg.context_parallel_axis)
+                    * tokens.shape[1]
+                )
+                position_ids = position_ids + start
         pos = jnp.take(self.position_embeddings, position_ids, axis=0).astype(
             cfg.dtype
         )
