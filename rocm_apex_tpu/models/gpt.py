@@ -113,6 +113,27 @@ def _init(cfg: GPTConfig):
     return nn.initializers.normal(stddev=cfg.init_method_std)
 
 
+class _Dropout(nn.Module):
+    """Dropout that folds the context-parallel rank into the RNG so
+    sequence shards draw independent masks (the CP analogue of the TP
+    rank fold, tensor_parallel/random.py:58)."""
+
+    rate: float
+    cp_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        if deterministic or self.rate == 0.0:
+            return x
+        rng = self.make_rng("dropout")
+        if self.cp_axis is not None:
+            rng = jax.random.fold_in(
+                rng, jax.lax.axis_index(self.cp_axis)
+            )
+        keep = jax.random.bernoulli(rng, 1.0 - self.rate, x.shape)
+        return jnp.where(keep, x / (1.0 - self.rate), 0.0).astype(x.dtype)
+
+
 def _scaled_init(cfg: GPTConfig):
     """Output-layer init scaled by 1/sqrt(2*num_layers), Megatron's
     scheme for residual-path projections (standalone_gpt.py uses
@@ -199,6 +220,18 @@ class ParallelAttention(nn.Module):
         use_flash = cfg.attention_impl == "flash" and (
             cfg.attention_dropout == 0.0 or deterministic
         )
+        if cfg.context_parallel_axis is not None and (
+            not use_flash or self.attn_mask_type != "causal"
+        ):
+            # silently attending within the local shard only would be a
+            # wrong model; context parallelism rides the ring-flash path
+            raise ValueError(
+                "context_parallel_axis requires attention_impl='flash', "
+                "causal masking, and attention_dropout=0 in training "
+                f"(got impl={cfg.attention_impl!r}, "
+                f"mask={self.attn_mask_type!r}, "
+                f"attn_dropout={cfg.attention_dropout})"
+            )
         use_pallas_softmax = (
             cfg.use_pallas_softmax and cfg.attention_impl != "jnp"
         )
@@ -304,7 +337,7 @@ class ParallelTransformerLayer(nn.Module):
             ln1, attention_mask, deterministic
         )
         if cfg.hidden_dropout > 0.0:
-            attn = nn.Dropout(rate=cfg.hidden_dropout)(
+            attn = _Dropout(cfg.hidden_dropout, cfg.context_parallel_axis)(
                 attn, deterministic=deterministic
             )
         residual = ln1 if cfg.apply_residual_connection_post_layernorm else x
@@ -317,7 +350,7 @@ class ParallelTransformerLayer(nn.Module):
         )(x)
         mlp = ParallelMLP(cfg, name="mlp")(ln2, deterministic)
         if cfg.hidden_dropout > 0.0:
-            mlp = nn.Dropout(rate=cfg.hidden_dropout)(
+            mlp = _Dropout(cfg.hidden_dropout, cfg.context_parallel_axis)(
                 mlp, deterministic=deterministic
             )
         residual = ln2 if cfg.apply_residual_connection_post_layernorm else x
@@ -383,7 +416,9 @@ class TransformerEmbedding(nn.Module):
             (cfg.max_position_embeddings, cfg.hidden_size),
             cfg.params_dtype,
         )
-        self.dropout = nn.Dropout(rate=cfg.hidden_dropout)
+        self.dropout = _Dropout(
+            cfg.hidden_dropout, cfg.context_parallel_axis
+        )
 
     def __call__(self, tokens, position_ids=None, deterministic: bool = True):
         cfg = self.cfg
