@@ -538,3 +538,114 @@ class TestPipelineWithEmbedding:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
+
+    def test_gpt_interleaved_pipeline_matches_serial(self, eight_devices):
+        """Same bar for the interleaved schedule: vp=2 chunks x PP=4
+        stages = 8 layers, embedding + tied head trained through."""
+        from rocm_apex_tpu.models.gpt import (
+            GPTConfig,
+            ParallelTransformerLayer,
+            TransformerEmbedding,
+            _serial_cross_entropy,
+        )
+
+        vp = 2
+        n_layers = vp * PP
+        cfg = GPTConfig(
+            vocab_size=64,
+            hidden_size=32,
+            num_layers=n_layers,
+            num_attention_heads=2,
+            max_position_embeddings=16,
+            hidden_dropout=0.0,
+            attention_dropout=0.0,
+            tensor_parallel_size=1,
+            params_dtype=jnp.float32,
+            dtype=jnp.float32,
+            attention_impl="jnp",
+            use_pallas_softmax=False,
+        )
+        emb = TransformerEmbedding(cfg)
+        layer = ParallelTransformerLayer(cfg)
+        mb, seq = 2, 16
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(20), (M, mb, seq), 0, cfg.vocab_size
+        )
+        labels = jnp.roll(tokens, -1, axis=-1)
+
+        e_params = emb.init(jax.random.PRNGKey(21), tokens[0])
+        x0 = emb.apply(e_params, tokens[0])
+        l_params = [
+            layer.init(jax.random.fold_in(jax.random.PRNGKey(22), i), x0)
+            for i in range(n_layers)
+        ]
+        # global stage g = v*PP + s -> stacked (vp, PP, ...), pipe on axis 1
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *l_params)
+        chunked = jax.tree_util.tree_map(
+            lambda v: v.reshape((vp, PP) + v.shape[1:]), stacked
+        )
+
+        def pre_fn(extra, tok):
+            return emb.apply(extra, tok)
+
+        def stage(p, x):
+            return layer.apply(p, x)
+
+        def loss_with_head(extra, y, tgt):
+            logits = emb.apply(extra, y, method=TransformerEmbedding.attend)
+            return jnp.mean(_serial_cross_entropy(logits, tgt))
+
+        mesh = pipe_mesh(eight_devices)
+
+        def local(p, e, x, t):
+            p = jax.tree_util.tree_map(lambda v: jnp.squeeze(v, 1), p)
+            losses, (grads, egrads) = (
+                forward_backward_pipelining_with_interleaving(
+                    stage, loss_with_head, p, x, t,
+                    axis_name="pipe", extra_params=e, pre_fn=pre_fn,
+                )
+            )
+            grads = jax.tree_util.tree_map(lambda v: v[:, None], grads)
+            return losses, (grads, egrads)
+
+        f = shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, "pipe"), P(), P(), P()),
+            out_specs=(P(), (P(None, "pipe"), P())),
+            check_rep=False,
+        )
+        losses, (lgrads, egrads) = jax.jit(f)(chunked, e_params, tokens, labels)
+
+        def total_loss(lp, ep):
+            def one(tok, tgt):
+                x = emb.apply(ep, tok)
+                for g in range(n_layers):
+                    x = layer.apply(
+                        jax.tree_util.tree_map(lambda v: v[g], lp), x
+                    )
+                logits = emb.apply(ep, x, method=TransformerEmbedding.attend)
+                return jnp.mean(_serial_cross_entropy(logits, tgt))
+
+            losses = jax.vmap(one)(tokens, labels)
+            return jnp.mean(losses), losses
+
+        (_, exp_losses), (exp_l, exp_e) = jax.value_and_grad(
+            total_loss, argnums=(0, 1), has_aux=True
+        )(stacked, e_params)
+
+        np.testing.assert_allclose(
+            np.asarray(losses), np.asarray(exp_losses), rtol=1e-5, atol=1e-6
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(lgrads), jax.tree_util.tree_leaves(exp_l)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a).reshape(np.asarray(b).shape),
+                np.asarray(b), rtol=1e-4, atol=1e-5,
+            )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(egrads), jax.tree_util.tree_leaves(exp_e)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
