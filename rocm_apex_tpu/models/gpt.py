@@ -217,11 +217,23 @@ class ParallelAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
 
         scale = 1.0 / np.sqrt(hd)
+        # in-kernel flash dropout needs the TPU PRNG (no interpret-mode
+        # lowering) and is not available on the ring (CP) path
+        from rocm_apex_tpu.ops._pallas import on_tpu
+
+        dropout_active = cfg.attention_dropout > 0.0 and not deterministic
+        use_flash_dropout = (
+            cfg.attention_impl == "flash"
+            and dropout_active
+            and self.attn_mask_type == "causal"
+            and cfg.context_parallel_axis is None
+            and on_tpu()
+        )
         use_flash = cfg.attention_impl == "flash" and (
-            cfg.attention_dropout == 0.0 or deterministic
+            not dropout_active or use_flash_dropout
         )
         if cfg.context_parallel_axis is not None and (
-            not use_flash or self.attn_mask_type != "causal"
+            not use_flash or self.attn_mask_type != "causal" or dropout_active
         ):
             # silently attending within the local shard only would be a
             # wrong model; context parallelism rides the ring-flash path
@@ -248,6 +260,18 @@ class ParallelAttention(nn.Module):
                     ctxf = ring_flash_attention(
                         qf, kf, vf, cfg.context_parallel_axis,
                         causal=True, scale=scale,
+                    )
+                elif use_flash_dropout:
+                    from rocm_apex_tpu.ops.flash_attention import (
+                        flash_attention_dropout,
+                    )
+
+                    seed = jax.random.randint(
+                        self.make_rng("dropout"), (), 0, 2**31 - 1, jnp.int32
+                    )
+                    ctxf = flash_attention_dropout(
+                        qf, kf, vf, None, seed, cfg.attention_dropout,
+                        True, scale,
                     )
                 else:
                     ctxf = flash_attention(qf, kf, vf, None, True, scale)

@@ -33,7 +33,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from rocm_apex_tpu.ops._pallas import pallas_call
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "flash_attention_dropout",
+]
 
 # Large blocks keep the sequential TPU grid short (per-step overhead is
 # the dominant cost at small blocks) while staying well inside VMEM:
@@ -52,16 +56,29 @@ def _round_up(x, m):
 # ---------------------------------------------------------------------------
 
 
+def _keep_mask(seed_ref, rate, b, qi, ki, shape):
+    """Deterministic per-(batch, q-block, k-block) keep mask; the same
+    seeding in forward and both backward kernels regenerates identical
+    bits (the flash-dropout recompute trick — no mask is stored)."""
+    # single combined scalar (multi-arg prng_seed does not lower on
+    # all backends); distinct odd multipliers keep block seeds disjoint
+    pltpu.prng_seed(
+        seed_ref[0] + b * 1000003 + qi * 10007 + ki * 101
+    )
+    bits = pltpu.prng_random_bits(shape)
+    thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return bits.astype(jnp.uint32) >= thresh
+
+
 def _fwd_kernel(
-    causal, scale, sk_real, block_q, block_k, has_bias,
+    causal, scale, sk_real, block_q, block_k, has_bias, dropout_rate,
     q_ref, k_ref, v_ref, *refs,
 ):
-    if has_bias:
-        bias_ref, o_ref, lse_ref = refs[:3]
-        m_scr, l_scr, acc_scr = refs[3:]
-    else:
-        o_ref, lse_ref = refs[:2]
-        m_scr, l_scr, acc_scr = refs[2:]
+    refs = list(refs)
+    bias_ref = refs.pop(0) if has_bias else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -99,7 +116,15 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
+        # the softmax normalizer uses the UNdropped probabilities;
+        # dropout zeroes entries of the normalized matrix (torch order:
+        # softmax -> dropout -> @v)
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(
+                seed_ref, dropout_rate, b, qi, ki, (block_q, block_k)
+            )
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
@@ -119,7 +144,8 @@ def _fwd_kernel(
         lse_ref[0] = (m_scr[:, :1] + jnp.log(safe_l))
 
 
-def _fwd(q, k, v, bias, causal, scale, block_q, block_k):
+def _fwd(q, k, v, bias, causal, scale, block_q, block_k,
+         dropout_rate=0.0, dropout_seed=None):
     bh, sq, d0 = q.shape
     sk = k.shape[1]
     # lane-align head_dim (zero feature columns are inert in q@k^T and
@@ -155,10 +181,14 @@ def _fwd(q, k, v, bias, causal, scale, block_q, block_k):
         in_specs.append(
             pl.BlockSpec((1, block_q, block_k), lambda b, i, j: (b // hp, i, j))
         )
+    if dropout_rate > 0.0:
+        ins.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     o, lse = pallas_call(
         functools.partial(
-            _fwd_kernel, causal, scale, sk, block_q, block_k, has_bias
+            _fwd_kernel, causal, scale, sk, block_q, block_k, has_bias,
+            dropout_rate,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -185,13 +215,14 @@ def _fwd(q, k, v, bias, causal, scale, block_q, block_k):
 
 
 def _bwd_dkv_kernel(
-    causal, scale, sk_real, block_q, block_k, has_bias,
+    causal, scale, sk_real, block_q, block_k, has_bias, dropout_rate,
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 ):
-    if has_bias:
-        (bias_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    refs = list(refs)
+    bias_ref = refs.pop(0) if has_bias else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    (dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    b = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -225,12 +256,22 @@ def _bwd_dkv_kernel(
             )
             s = jnp.where(row >= col, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv_scr[...] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            # identical regeneration of the forward's keep mask
+            keep = _keep_mask(
+                seed_ref, dropout_rate, b, qi, ki, (block_q, block_k)
+            )
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            p_drop = p
+        dv_scr[...] += jax.lax.dot_general(
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
@@ -251,13 +292,14 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq_kernel(
-    causal, scale, sk_real, block_q, block_k, has_bias,
+    causal, scale, sk_real, block_q, block_k, has_bias, dropout_rate,
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 ):
-    if has_bias:
-        (bias_ref, dq_ref, dq_scr) = refs
-    else:
-        (dq_ref, dq_scr) = refs
+    refs = list(refs)
+    bias_ref = refs.pop(0) if has_bias else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    (dq_ref, dq_scr) = refs
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -294,6 +336,11 @@ def _bwd_dq_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            keep = _keep_mask(
+                seed_ref, dropout_rate, b, qi, ki, (block_q, block_k)
+            )
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[...] += jax.lax.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
@@ -309,7 +356,8 @@ def _bwd_dq_kernel(
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd(causal, scale, block_q, block_k, res, do, dlse=None):
+def _bwd(causal, scale, block_q, block_k, res, do, dlse=None,
+         dropout_rate=0.0, dropout_seed=None):
     q, k, v, bias, o, lse = res
     bh, sq, d0 = q.shape
     sk = k.shape[1]
@@ -365,12 +413,17 @@ def _bwd(causal, scale, block_q, block_k, res, do, dlse=None):
                     (1, block_q, block_k), lambda b, j, i: (b // hp, i, j)
                 )
             )
+        if dropout_rate > 0.0:
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         return specs
 
     ins = common_ins + ([bp] if has_bias else [])
+    if dropout_rate > 0.0:
+        ins.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
     dk, dv = pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, causal, scale, sk, block_q, block_k, has_bias
+            _bwd_dkv_kernel, causal, scale, sk, block_q, block_k, has_bias,
+            dropout_rate,
         ),
         grid=(bh, sk_p // block_k, sq_p // block_q),
         in_specs=_kv_specs(),
@@ -404,11 +457,14 @@ def _bwd(causal, scale, block_q, block_k, res, do, dlse=None):
                     (1, block_q, block_k), lambda b, i, j: (b // hp, i, j)
                 )
             )
+        if dropout_rate > 0.0:
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         return specs
 
     dq = pallas_call(
         functools.partial(
-            _bwd_dq_kernel, causal, scale, sk, block_q, block_k, has_bias
+            _bwd_dq_kernel, causal, scale, sk, block_q, block_k, has_bias,
+            dropout_rate,
         ),
         grid=(bh, sq_p // block_q, sk_p // block_k),
         in_specs=_q_specs(),
@@ -517,3 +573,62 @@ def _fal_bwd(causal, scale, block_q, block_k, res, cot):
 
 
 flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_dropout(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    dropout_seed,
+    dropout_rate: float,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jnp.ndarray:
+    """`flash_attention` with in-kernel attention dropout.
+
+    Torch semantics (softmax -> dropout -> @v): the normalizer uses the
+    undropped probabilities and kept entries scale by 1/(1-rate). The
+    keep mask is never materialized — all three kernels regenerate it
+    from ``dropout_seed`` and the (batch, q-block, k-block) grid
+    coordinates via the TPU PRNG (reference: the fused dropout of
+    apex/contrib/csrc/multihead_attn and fmha kernels). TPU-only:
+    `pltpu.prng_*` has no interpret-mode lowering — callers off-TPU
+    must use their materialized fallback (ops._pallas.on_tpu()).
+    ``dropout_seed`` is a traced int32 scalar, so per-step seeds do not
+    recompile.
+    """
+    o, _ = _fwd(
+        q, k, v, bias, causal,
+        scale if scale is not None else 1.0 / np.sqrt(q.shape[-1]),
+        block_q, block_k,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+    )
+    return o
+
+
+def _fad_fwd(q, k, v, bias, dropout_seed, dropout_rate, causal, scale,
+             block_q, block_k):
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    o, lse = _fwd(
+        q, k, v, bias, causal, s, block_q, block_k,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+    )
+    return o, (q, k, v, bias, o, lse, dropout_seed)
+
+
+def _fad_bwd(dropout_rate, causal, scale, block_q, block_k, res, do):
+    q, k, v, bias, o, lse, seed = res
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    dq, dk, dv, dbias = _bwd(
+        causal, s, block_q, block_k, (q, k, v, bias, o, lse), do,
+        dropout_rate=dropout_rate, dropout_seed=seed,
+    )
+    seed_ct = np.zeros((), jax.dtypes.float0)
+    return (dq, dk, dv, dbias, seed_ct)
+
+
+flash_attention_dropout.defvjp(_fad_fwd, _fad_bwd)

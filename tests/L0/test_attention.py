@@ -227,3 +227,66 @@ class TestMultiheadAttn:
             rngs={"dropout": jax.random.PRNGKey(14)},
         )
         assert out3.shape == (b, sq, h)
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="in-kernel dropout uses the TPU PRNG (no interpret lowering)",
+)
+class TestFlashDropoutTPU:
+    """Runs only on real TPU (APEX_TPU_TEST_PLATFORM=axon)."""
+
+    def test_mask_statistics_and_determinism(self):
+        from rocm_apex_tpu.ops.flash_attention import flash_attention_dropout
+
+        s = 128
+        seed = jnp.asarray(123, jnp.int32)
+        z = jnp.zeros((1, s, s))
+        P = np.asarray(
+            flash_attention_dropout(z, z, jnp.eye(s)[None], None, seed, 0.3)
+        )[0]
+        assert abs((P == 0).mean() - 0.3) < 0.05
+        assert abs(P.sum(1).mean() - 1.0) < 0.05
+        P2 = np.asarray(
+            flash_attention_dropout(z, z, jnp.eye(s)[None], None, seed, 0.3)
+        )[0]
+        np.testing.assert_array_equal(P, P2)
+
+    def test_grads_match_masked_reference(self):
+        from rocm_apex_tpu.ops.flash_attention import flash_attention_dropout
+
+        s = d = 128
+        rate = 0.2
+        seed = jnp.asarray(5, jnp.int32)
+        z = jnp.zeros((1, s, s))
+        keep = jnp.asarray(
+            np.asarray(
+                flash_attention_dropout(
+                    z, z, jnp.eye(s)[None], None, seed, rate
+                )
+            )[0]
+            > 0
+        )[None]
+        q = jax.random.normal(jax.random.PRNGKey(1), (1, s, d)) * 0.5
+        k = jax.random.normal(jax.random.PRNGKey(2), (1, s, d)) * 0.5
+        v = jax.random.normal(jax.random.PRNGKey(3), (1, s, d)) * 0.5
+
+        def ref(q, k, v):
+            sc = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(d)
+            p = jax.nn.softmax(sc, -1)
+            pd = jnp.where(keep, p / (1 - rate), 0.0)
+            return jnp.einsum("bqk,bkd->bqd", pd, v)
+
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention_dropout(q, k, v, None, seed, rate) ** 2
+            ),
+            (0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(ref(q, k, v) ** 2), (0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2
+            )
