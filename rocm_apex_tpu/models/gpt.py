@@ -114,22 +114,25 @@ def _init(cfg: GPTConfig):
 
 
 class _Dropout(nn.Module):
-    """Dropout that folds the context-parallel rank into the RNG so
-    sequence shards draw independent masks (the CP analogue of the TP
-    rank fold, tensor_parallel/random.py:58)."""
+    """Dropout that folds mesh-axis ranks into the RNG so shards draw
+    independent masks: the context axis for sequence shards and the
+    tensor axis where the dropped tensor is TP-sharded (attention
+    probs, disjoint head shards per rank) — the analogue of the
+    reference's get_cuda_rng_tracker().fork()
+    (tensor_parallel/random.py:58)."""
 
     rate: float
     cp_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         if deterministic or self.rate == 0.0:
             return x
         rng = self.make_rng("dropout")
-        if self.cp_axis is not None:
-            rng = jax.random.fold_in(
-                rng, jax.lax.axis_index(self.cp_axis)
-            )
+        for axis in (self.cp_axis, self.tp_axis):
+            if axis is not None:
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
         keep = jax.random.bernoulli(rng, 1.0 - self.rate, x.shape)
         return jnp.where(keep, x / (1.0 - self.rate), 0.0).astype(x.dtype)
 
@@ -266,8 +269,16 @@ class ParallelAttention(nn.Module):
                         flash_attention_dropout,
                     )
 
+                    rng = self.make_rng("dropout")
+                    if tp > 1:
+                        # the head shards are disjoint per TP rank;
+                        # without the fold every rank's kernel seeds the
+                        # same (b, qi, ki) streams -> correlated masks
+                        rng = jax.random.fold_in(
+                            rng, jax.lax.axis_index(cfg.tensor_axis)
+                        )
                     seed = jax.random.randint(
-                        self.make_rng("dropout"), (), 0, 2**31 - 1, jnp.int32
+                        rng, (), 0, 2**31 - 1, jnp.int32
                     )
                     ctxf = flash_attention_dropout(
                         qf, kf, vf, None, seed, cfg.attention_dropout,
@@ -320,10 +331,12 @@ class ParallelAttention(nn.Module):
             if cfg.attention_dropout > 0.0:
                 # The reference forks the model-parallel RNG for attention
                 # dropout (get_cuda_rng_tracker().fork(), standalone_gpt.py);
-                # flax's named RNG + TP-rank folding is the equivalent.
-                probs = nn.Dropout(rate=cfg.attention_dropout)(
-                    probs, deterministic=deterministic
-                )
+                # the probs are TP-sharded over heads, so the tensor rank
+                # must be folded in or every rank draws the same mask.
+                probs = _Dropout(
+                    cfg.attention_dropout,
+                    tp_axis=cfg.tensor_axis if tp > 1 else None,
+                )(probs, deterministic=deterministic)
 
             ctx = jnp.einsum(
                 "bnqk,bknd->bqnd", probs, v, preferred_element_type=cfg.dtype
@@ -533,7 +546,8 @@ def gpt_loss_fn(losses, loss_mask=None):
 
 
 def gpt_pipeline_functions(cfg: GPTConfig):
-    """(embedding, layer, pre_fn, loss_fn) for the pipeline schedules.
+    """(embedding, layer, pre_fn, stage_fn, loss_fn) for the pipeline
+    schedules.
 
     The full GPT split the way the reference's build_model does
     (schedules/common.py:18-106): embedding on the entry stage

@@ -60,11 +60,30 @@ def _keep_mask(seed_ref, rate, b, qi, ki, shape):
     """Deterministic per-(batch, q-block, k-block) keep mask; the same
     seeding in forward and both backward kernels regenerates identical
     bits (the flash-dropout recompute trick — no mask is stored)."""
-    # single combined scalar (multi-arg prng_seed does not lower on
-    # all backends); distinct odd multipliers keep block seeds disjoint
-    pltpu.prng_seed(
-        seed_ref[0] + b * 1000003 + qi * 10007 + ki * 101
-    )
+    # single combined scalar (multi-arg prng_seed does not lower on all
+    # backends). The coordinates are folded through murmur3-style
+    # multiply-rotate-xor rounds rather than an affine combination:
+    # affine seeds collide across (b, qi, ki) triples at large grids
+    # (e.g. qi ~ b-stride aliasing), which would correlate dropout
+    # masks between blocks exactly in the long-context regime.
+    def _mix(h, k):
+        k = k * jnp.uint32(0xCC9E2D51)
+        k = (k << 15) | (k >> 17)
+        k = k * jnp.uint32(0x1B873593)
+        h = h ^ k
+        h = (h << 13) | (h >> 19)
+        return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+    h = seed_ref[0].astype(jnp.uint32)
+    for coord in (b, qi, ki):
+        h = _mix(h, coord.astype(jnp.uint32))
+    # fmix32 avalanche so low-bit coordinate differences reach all bits
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    pltpu.prng_seed(jax.lax.bitcast_convert_type(h, jnp.int32))
     bits = pltpu.prng_random_bits(shape)
     thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return bits.astype(jnp.uint32) >= thresh

@@ -71,23 +71,35 @@ from rocm_apex_tpu.transformer import parallel_state
 
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _replicate_masked(x, maskf, axis):
-    """Broadcast masked values across the axis: out = psum(x * maskf).
+    """Broadcast masked values across the axis:
+    out = psum(where(maskf, x, 0)).
 
     Explicit VJP because the raw psum's transpose depends on shard_map
     replication tracking: with check_rep=False it degenerates to a psum
     of cotangents and every gradient through the loss replication comes
     back axis-size times too large. The true transpose of "replicate
     from the masked rank" keeps the cotangent only where the mask is
-    set — correct under either check_rep setting."""
-    return jax.lax.psum(x * maskf, axis)
+    set — correct under either check_rep setting.
+
+    Masking is a select, not a multiply: non-exit ranks run the head on
+    zero activation buffers, and a NaN/Inf produced there would survive
+    ``NaN * 0`` and poison the psum for every rank. ``where`` discards
+    the non-exit value outright."""
+    return jax.lax.psum(jnp.where(maskf != 0, x, jnp.zeros_like(x)), axis)
 
 
 def _replicate_masked_fwd(x, maskf, axis):
-    return jax.lax.psum(x * maskf, axis), maskf
+    return (
+        jax.lax.psum(jnp.where(maskf != 0, x, jnp.zeros_like(x)), axis),
+        maskf,
+    )
 
 
 def _replicate_masked_bwd(axis, maskf, ct):
-    return (ct * maskf, jnp.zeros_like(maskf))
+    return (
+        jnp.where(maskf != 0, ct, jnp.zeros_like(ct)),
+        jnp.zeros_like(maskf),
+    )
 
 
 _replicate_masked.defvjp(_replicate_masked_fwd, _replicate_masked_bwd)
@@ -119,17 +131,33 @@ def _stage0_inputs(pre_fn, extra, inputs, axis):
     return x0_all, jax.eval_shape(lambda x: x[0], x0_all)
 
 
-def _head_losses(loss_fn, has_extra, extra, y_buf, targets, axis):
+def _head_losses(loss_fn, has_extra, extra, y_buf, targets, axis, is_last):
     """(M,) per-microbatch losses: the post_process head applied ONCE
-    per microbatch after the scan (not per tick). Non-exit ranks run it
-    on their zero y_buf; the masked replicate downstream discards the
-    values and zeroes the cotangents."""
+    per microbatch after the scan (not per tick), and ONLY on the exit
+    stage. The `cond` (not a select) matters twice over: non-exit ranks
+    skip the head's M vmapped applications entirely, and — since
+    `cond`'s VJP differentiates only the taken branch — a user loss_fn
+    that produces Inf/NaN on zero activation buffers cannot leak NaN
+    into non-exit gradients via the 0·Inf of a masked-output transpose.
+    The predicate depends only on the pipe rank, so any collective
+    inside loss_fn (e.g. the vocab-parallel CE's tensor-axis psum) sees
+    a uniform decision within its device group."""
 
     def one(y, t):
         loss = loss_fn(extra, y, t) if has_extra else loss_fn(y, t)
         return loss.astype(jnp.float32)
 
-    return _pcast_varying(jax.vmap(one)(y_buf, targets), axis)
+    m = y_buf.shape[0]
+
+    def _real():
+        return _pcast_varying(jax.vmap(one)(y_buf, targets), axis)
+
+    def _zero():
+        # the zero branch must carry the same varying-over-axis type as
+        # the real branch or cond rejects the branch pair
+        return _pcast_varying(jnp.zeros((m,), jnp.float32), axis)
+
+    return jax.lax.cond(is_last, _real, _zero)
 
 
 __all__ = [
@@ -304,7 +332,7 @@ def forward_backward_pipelining_without_interleaving(
         (_, y_buf), _ = jax.lax.scan(tick, (act0, ybuf0), jnp.arange(ticks))
         # post_process on the last stage, once per microbatch
         loss_buf = _head_losses(
-            loss_fn, has_extra, extra, y_buf, targets, axis
+            loss_fn, has_extra, extra, y_buf, targets, axis, is_last
         )
         # Replicate the last stage's losses to every stage so the caller
         # sees one logical value (reference keeps losses on the last
@@ -433,7 +461,7 @@ def forward_backward_pipelining_with_interleaving(
         )
         (_, y_buf), _ = jax.lax.scan(tick, (act0, ybuf0), jnp.arange(ticks))
         loss_buf = _head_losses(
-            loss_fn, has_extra, extra, y_buf, targets, axis
+            loss_fn, has_extra, extra, y_buf, targets, axis, is_last
         )
         loss_buf = _replicate_masked(
             loss_buf, is_last.astype(loss_buf.dtype), axis

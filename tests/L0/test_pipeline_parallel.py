@@ -649,3 +649,35 @@ class TestPipelineWithEmbedding:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
+
+
+class TestNaNSafeLossReplication:
+    """A loss_fn that is NaN/Inf on zero activations must not poison
+    non-exit stages (advisor round-1 medium: NaN*0 through the masked
+    psum; plus the 0·Inf backward hazard — the head runs under a rank
+    cond, so non-exit ranks never differentiate it)."""
+
+    def test_inf_on_zero_loss_fn(self, eight_devices):
+        mesh = pipe_mesh(eight_devices)
+        params, inputs, targets = make_data(jax.random.PRNGKey(7))
+
+        def spiky_loss(y, target):
+            # log(sum(y^2)) -> -inf at y == 0 (non-exit ranks' y_buf);
+            # grad 2y/sum(y^2) -> inf at 0
+            return jnp.log(jnp.sum((y - target) ** 2) + 1e-30)
+
+        def local(p, x, t):
+            return forward_backward_pipelining_without_interleaving(
+                stage_fn, spiky_loss, p, x, t, axis_name="pipe"
+            )
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P("pipe"), P(), P()),
+            out_specs=(P(), P("pipe")),
+        )
+        losses, grads = jax.jit(f)(params, inputs, targets)
+        assert np.isfinite(np.asarray(losses)).all()
+        for leaf in jax.tree_util.tree_leaves(grads):
+            assert np.isfinite(np.asarray(leaf)).all()
