@@ -6,9 +6,10 @@ packed along the token axis, ``cu_seqlens`` (b+1,) int32 prefix
 offsets, returns ``(total, h, d)``). The reference's hand-tiled kernels
 cap seqlen at 512 with `_nl` variants for small batch
 (apex/contrib/csrc/fmha/); this unpacks into a padded batch, runs the
-Pallas flash kernel with a per-sequence validity bias, and re-packs.
-The unpack/re-pack are gathers XLA fuses around the kernel; padded rows
-never reach HBM as attention scores (flash never materializes them).
+Pallas flash kernel with an in-kernel per-row key-length bound
+(`flash_attention_varlen`), and re-packs. The unpack/re-pack are
+gathers XLA fuses around the kernel; no (s, s) score or mask tensor
+ever materializes in HBM.
 """
 
 from typing import Optional
@@ -17,7 +18,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from rocm_apex_tpu.ops.flash_attention import flash_attention
+from rocm_apex_tpu.ops.flash_attention import flash_attention_varlen
 
 __all__ = ["fmha", "FMHA"]
 
@@ -56,12 +57,14 @@ def fmha(
     k = padded[:, :, 1].transpose(0, 2, 1, 3).reshape(b * h, max_s, d)
     v = padded[:, :, 2].transpose(0, 2, 1, 3).reshape(b * h, max_s, d)
 
+    # per-(batch*heads)-row key bound, enforced IN-KERNEL: no (s, s)
+    # mask tensor ever reaches HBM (round-1 review: the previous
+    # materialized additive bias was the exact O(b·s²) buffer flash
+    # attention exists to avoid)
     lengths = cu_seqlens[1:] - cu_seqlens[:-1]  # (b,)
-    valid = jnp.arange(max_s)[None, :] < lengths[:, None]  # (b, max_s)
-    bias = jnp.where(valid[:, None, :], 0.0, -1e30).astype(jnp.float32)
-    bias = jnp.broadcast_to(bias, (b, max_s, max_s))
+    kv_lengths = jnp.repeat(lengths.astype(jnp.int32), h)  # (b*h,)
 
-    ctx = flash_attention(q, k, v, bias, causal, scale)
+    ctx = flash_attention_varlen(q, k, v, kv_lengths, causal, scale)
     ctx = ctx.reshape(b, h, max_s, d).transpose(0, 2, 1, 3)  # (b, s, h, d)
     return ctx[seq_id, offset]
 

@@ -69,6 +69,8 @@ def _attend(q, k, v, bias, heads, dropout, deterministic, dropout_rng):
     kh = k.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
     vh = v.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
     if use_flash:
+        # bias here is always a mask (key padding / attn mask), never
+        # learned: skip the dbias kernel explicitly
         ctx = flash_attention(
             qh.reshape(b * heads, sq, d),
             kh.reshape(b * heads, sk, d),
@@ -76,6 +78,7 @@ def _attend(q, k, v, bias, heads, dropout, deterministic, dropout_rng):
             bias,
             False,
             scale,
+            compute_dbias=False,
         ).reshape(b, heads, sq, d)
     else:
         s = jnp.einsum(

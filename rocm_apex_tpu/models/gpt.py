@@ -296,7 +296,10 @@ class ParallelAttention(nn.Module):
                     -1e30,
                     0.0,
                 ).astype(jnp.float32)[:, 0]
-                ctxf = flash_attention(qf, kf, vf, fb, False, scale)
+                # fb is a constant padding mask: no dbias kernel
+                ctxf = flash_attention(
+                    qf, kf, vf, fb, False, scale, compute_dbias=False
+                )
             ctx = (
                 ctxf.reshape(b, nh_local, sq, hd)
                 .transpose(0, 2, 1, 3)

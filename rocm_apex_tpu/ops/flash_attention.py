@@ -35,6 +35,7 @@ from rocm_apex_tpu.ops._pallas import pallas_call
 
 __all__ = [
     "flash_attention",
+    "flash_attention_varlen",
     "flash_attention_with_lse",
     "flash_attention_dropout",
 ]
@@ -89,13 +90,48 @@ def _keep_mask(seed_ref, rate, b, qi, ki, shape):
     return bits.astype(jnp.uint32) >= thresh
 
 
+def _masked_scores(
+    causal, scale, sk_real, block_q, block_k,
+    q, k, bias_ref, len_ref, b, qi, ki,
+):
+    """The masked fp32 score block for grid point (b, qi, ki) — shared
+    by ALL FOUR kernels (fwd, dkv, dq, dbias). Masking semantics live
+    here and only here: a change applied to one kernel but not the
+    others would silently desynchronize forward and backward
+    probabilities."""
+    # native-dtype MXU operands (bf16 in / fp32 accumulate); an
+    # explicit fp32 upcast here would fall off the fast MXU path
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    if sk_real % block_k != 0:
+        s = jnp.where(col < sk_real, s, NEG_INF)
+    if len_ref is not None:
+        # per-row real key length (varlen): in-kernel bound, the
+        # flash-grade replacement for a materialized (s, s) mask
+        s = jnp.where(col < len_ref[b], s, NEG_INF)
+    if causal:
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        s = jnp.where(row >= col, s, NEG_INF)
+    return s
+
+
 def _fwd_kernel(
     causal, scale, sk_real, block_q, block_k, has_bias, dropout_rate,
-    q_ref, k_ref, v_ref, *refs,
+    has_lengths, q_ref, k_ref, v_ref, *refs,
 ):
     refs = list(refs)
     bias_ref = refs.pop(0) if has_bias else None
     seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    len_ref = refs.pop(0) if has_lengths else None
     o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     b = pl.program_id(0)
     qi = pl.program_id(1)
@@ -112,24 +148,10 @@ def _fwd_kernel(
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        # native-dtype MXU operands (bf16 in / fp32 accumulate); an
-        # explicit fp32 upcast here would fall off the fast MXU path
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if has_bias:
-            s = s + bias_ref[0].astype(jnp.float32)
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        s = _masked_scores(
+            causal, scale, sk_real, block_q, block_k,
+            q, k, bias_ref, len_ref, b, qi, ki,
         )
-        if sk_real % block_k != 0:
-            s = jnp.where(col < sk_real, s, NEG_INF)
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            s = jnp.where(row >= col, s, NEG_INF)
 
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -164,7 +186,7 @@ def _fwd_kernel(
 
 
 def _fwd(q, k, v, bias, causal, scale, block_q, block_k,
-         dropout_rate=0.0, dropout_seed=None):
+         dropout_rate=0.0, dropout_seed=None, kv_lengths=None):
     bh, sq, d0 = q.shape
     sk = k.shape[1]
     # lane-align head_dim (zero feature columns are inert in q@k^T and
@@ -203,11 +225,15 @@ def _fwd(q, k, v, bias, causal, scale, block_q, block_k,
     if dropout_rate > 0.0:
         ins.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    has_lengths = kv_lengths is not None
+    if has_lengths:
+        ins.append(jnp.asarray(kv_lengths, jnp.int32))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     o, lse = pallas_call(
         functools.partial(
             _fwd_kernel, causal, scale, sk, block_q, block_k, has_bias,
-            dropout_rate,
+            dropout_rate, has_lengths,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -235,11 +261,12 @@ def _fwd(q, k, v, bias, causal, scale, block_q, block_k,
 
 def _bwd_dkv_kernel(
     causal, scale, sk_real, block_q, block_k, has_bias, dropout_rate,
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    has_lengths, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 ):
     refs = list(refs)
     bias_ref = refs.pop(0) if has_bias else None
     seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    len_ref = refs.pop(0) if has_lengths else None
     (dk_ref, dv_ref, dk_scr, dv_scr) = refs
     b = pl.program_id(0)
     ki = pl.program_id(1)
@@ -258,22 +285,10 @@ def _bwd_dkv_kernel(
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if has_bias:
-            s = s + bias_ref[0].astype(jnp.float32)
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        s = _masked_scores(
+            causal, scale, sk_real, block_q, block_k,
+            q, k, bias_ref, len_ref, b, qi, ki,
         )
-        if sk_real % block_k != 0:
-            s = jnp.where(col < sk_real, s, NEG_INF)
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            s = jnp.where(row >= col, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -312,11 +327,12 @@ def _bwd_dkv_kernel(
 
 def _bwd_dq_kernel(
     causal, scale, sk_real, block_q, block_k, has_bias, dropout_rate,
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    has_lengths, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 ):
     refs = list(refs)
     bias_ref = refs.pop(0) if has_bias else None
     seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    len_ref = refs.pop(0) if has_lengths else None
     (dq_ref, dq_scr) = refs
     b = pl.program_id(0)
     qi = pl.program_id(1)
@@ -334,22 +350,10 @@ def _bwd_dq_kernel(
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if has_bias:
-            s = s + bias_ref[0].astype(jnp.float32)
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        s = _masked_scores(
+            causal, scale, sk_real, block_q, block_k,
+            q, k, bias_ref, len_ref, b, qi, ki,
         )
-        if sk_real % block_k != 0:
-            s = jnp.where(col < sk_real, s, NEG_INF)
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            s = jnp.where(row >= col, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -375,8 +379,71 @@ def _bwd_dq_kernel(
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _bwd_dbias_kernel(
+    causal, scale, sk_real, block_q, block_k, hp, dropout_rate,
+    has_lengths, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    bias_ref, *refs,
+):
+    """dbias[n] = sum over the hp heads sharing bias row n of ds.
+
+    Grid (nb, q, kv, h) with the head-group dim INNERMOST: the output
+    bias block (n, i, j) is revisited on consecutive grid steps, so the
+    VMEM scratch accumulates across heads and writes back once — no
+    O(bh·s²) intermediate ever reaches HBM (only the O(nb·s²) gradient
+    the caller asked for).
+    """
+    refs = list(refs)
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    len_ref = refs.pop(0) if has_lengths else None
+    dbias_ref, db_scr = refs
+    n = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    h = pl.program_id(3)
+    b = n * hp + h
+
+    @pl.when(h == 0)
+    def _init():
+        db_scr[...] = jnp.zeros_like(db_scr)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = _masked_scores(
+            causal, scale, sk_real, block_q, block_k,
+            q, k, bias_ref, len_ref, b, qi, ki,
+        )
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            keep = _keep_mask(
+                seed_ref, dropout_rate, b, qi, ki, (block_q, block_k)
+            )
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+        # d loss / d bias_block == d loss / d s == ds without the
+        # outer scale (bias adds to s AFTER the q·k scaling)
+        db_scr[...] += p * (dp - delta)
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(_body)
+    else:
+        _body()
+
+    @pl.when(h == hp - 1)
+    def _finish():
+        dbias_ref[0] = db_scr[...].astype(dbias_ref.dtype)
+
+
 def _bwd(causal, scale, block_q, block_k, res, do, dlse=None,
-         dropout_rate=0.0, dropout_seed=None):
+         dropout_rate=0.0, dropout_seed=None, kv_lengths=None,
+         compute_dbias=True):
     q, k, v, bias, o, lse = res
     bh, sq, d0 = q.shape
     sk = k.shape[1]
@@ -434,15 +501,20 @@ def _bwd(causal, scale, block_q, block_k, res, do, dlse=None,
             )
         if dropout_rate > 0.0:
             specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        if has_lengths:
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         return specs
 
+    has_lengths = kv_lengths is not None
     ins = common_ins + ([bp] if has_bias else [])
     if dropout_rate > 0.0:
         ins.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
+    if has_lengths:
+        ins.append(jnp.asarray(kv_lengths, jnp.int32))
     dk, dv = pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal, scale, sk, block_q, block_k, has_bias,
-            dropout_rate,
+            dropout_rate, has_lengths,
         ),
         grid=(bh, sk_p // block_k, sq_p // block_q),
         in_specs=_kv_specs(),
@@ -478,12 +550,14 @@ def _bwd(causal, scale, block_q, block_k, res, do, dlse=None,
             )
         if dropout_rate > 0.0:
             specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        if has_lengths:
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         return specs
 
     dq = pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal, scale, sk, block_q, block_k, has_bias,
-            dropout_rate,
+            dropout_rate, has_lengths,
         ),
         grid=(bh, sq_p // block_q, sk_p // block_k),
         in_specs=_q_specs(),
@@ -493,10 +567,60 @@ def _bwd(causal, scale, block_q, block_k, res, do, dlse=None,
     )(*ins)
 
     dbias = None
-    if has_bias:
-        # bias is a constant mask in every supported use; a true bias
-        # gradient would need a third kernel emitting summed ds.
+    if has_bias and not compute_dbias:
+        # constant-mask caller (compute_dbias=False): no kernel launch,
+        # no O(nb·s²) gradient buffer — explicit, not DCE-dependent
         dbias = jnp.zeros_like(bias)
+    elif has_bias:
+        # dbias: grid (nb, q, kv, heads-per-bias-row), head dim
+        # innermost so the output block accumulates in VMEM. XLA DCEs
+        # this whole call when the caller does not differentiate bias.
+        def _db_specs():
+            specs = [
+                pl.BlockSpec(
+                    (1, block_q, d), lambda n, i, j, h: (n * hp + h, i, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_k, d), lambda n, i, j, h: (n * hp + h, j, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_k, d), lambda n, i, j, h: (n * hp + h, j, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_q, d), lambda n, i, j, h: (n * hp + h, i, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_q, 1), lambda n, i, j, h: (n * hp + h, i, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_q, 1), lambda n, i, j, h: (n * hp + h, i, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_q, block_k), lambda n, i, j, h: (n, i, j)
+                ),
+            ]
+            if dropout_rate > 0.0:
+                specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            if has_lengths:
+                specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            return specs
+
+        dbias_p = pallas_call(
+            functools.partial(
+                _bwd_dbias_kernel, causal, scale, sk, block_q, block_k,
+                hp, dropout_rate, has_lengths,
+            ),
+            grid=(nb, sq_p // block_q, sk_p // block_k, hp),
+            in_specs=_db_specs(),
+            out_specs=pl.BlockSpec(
+                (1, block_q, block_k), lambda n, i, j, h: (n, i, j)
+            ),
+            out_shape=jax.ShapeDtypeStruct((nb, sq_p, sk_p), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, block_k), jnp.float32)
+            ],
+        )(*ins)
+        dbias = dbias_p[:, :sq, :sk].astype(bias.dtype)
     return (
         dq[:, :sq, :d0],
         dk[:, :sk, :d0],
@@ -510,7 +634,7 @@ def _bwd(causal, scale, block_q, block_k, res, do, dlse=None,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -520,12 +644,18 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    compute_dbias: bool = True,
 ) -> jnp.ndarray:
     """Flash attention over (batch*heads, seq, head_dim) operands.
 
     ``bias`` additive (bh | 1, sq, sk); ``causal`` in-kernel triangular
     mask; ``scale`` defaults to 1/sqrt(head_dim). Differentiable in
-    q/k/v (bias gradients are returned as zeros — masks are constants).
+    q/k/v AND bias: learned additive biases (ALiBi slopes, relative
+    position) train correctly — dbias is computed by a dedicated
+    kernel summing ds over each bias row's head group. Callers whose
+    bias is a constant mask should pass ``compute_dbias=False`` to
+    skip that kernel explicitly (under jit XLA also DCEs it when the
+    bias cotangent is unused).
     """
     o, _ = _fwd(
         q, k, v, bias, causal,
@@ -535,18 +665,71 @@ def flash_attention(
     return o
 
 
-def _fa_fwd(q, k, v, bias, causal, scale, block_q, block_k):
+def _fa_fwd(q, k, v, bias, causal, scale, block_q, block_k, compute_dbias):
     s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     o, lse = _fwd(q, k, v, bias, causal, s, block_q, block_k)
     return o, (q, k, v, bias, o, lse)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, res, do):
+def _fa_bwd(causal, scale, block_q, block_k, compute_dbias, res, do):
     s = scale if scale is not None else 1.0 / np.sqrt(res[0].shape[-1])
-    return _bwd(causal, s, block_q, block_k, res, do)
+    return _bwd(
+        causal, s, block_q, block_k, res, do, compute_dbias=compute_dbias
+    )
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_varlen(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jnp.ndarray:
+    """`flash_attention` with a per-row real key length.
+
+    ``kv_lengths`` is (batch*heads,) int32: row b attends keys
+    ``[0, kv_lengths[b])``. The bound is enforced in-kernel via an iota
+    compare against an SMEM scalar — the flash-grade form of a padding
+    mask, with no (sq, sk) bias tensor in HBM (reference capability:
+    apex/contrib/fmha packed-varlen kernels, cu_seqlens semantics).
+    Rows whose length is 0 produce unspecified output (callers drop
+    padded rows). Differentiable in q/k/v.
+    """
+    o, _ = _fwd(
+        q, k, v, None, causal,
+        scale if scale is not None else 1.0 / np.sqrt(q.shape[-1]),
+        block_q, block_k, kv_lengths=kv_lengths,
+    )
+    return o
+
+
+def _fav_fwd(q, k, v, kv_lengths, causal, scale, block_q, block_k):
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    o, lse = _fwd(
+        q, k, v, None, causal, s, block_q, block_k, kv_lengths=kv_lengths
+    )
+    return o, (q, k, v, o, lse, kv_lengths)
+
+
+def _fav_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, o, lse, kv_lengths = res
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    dq, dk, dv, _ = _bwd(
+        causal, s, block_q, block_k, (q, k, v, None, o, lse), do,
+        kv_lengths=kv_lengths,
+    )
+    len_ct = np.zeros(kv_lengths.shape, jax.dtypes.float0)
+    return (dq, dk, dv, len_ct)
+
+
+flash_attention_varlen.defvjp(_fav_fwd, _fav_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
