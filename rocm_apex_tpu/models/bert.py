@@ -142,12 +142,12 @@ class BertModel(nn.Module):
         if lm_labels is None:
             return lm_logits, binary_logits
         tp = cfg.tensor_parallel_size or 1
+        # compute-dtype logits: both CE paths upcast internally per
+        # tile (no fp32 logits copy in HBM — see models/gpt.py)
         if tp > 1 or parallel_state.model_parallel_is_initialized():
             losses = vocab_parallel_cross_entropy(
-                lm_logits.astype(jnp.float32), lm_labels, cfg.tensor_axis
+                lm_logits, lm_labels, cfg.tensor_axis
             )
         else:
-            losses = _serial_cross_entropy(
-                lm_logits.astype(jnp.float32), lm_labels
-            )
+            losses = _serial_cross_entropy(lm_logits, lm_labels)
         return losses, binary_logits

@@ -168,6 +168,59 @@ class TestFMHA:
             )
 
 
+    @pytest.mark.parametrize("S", [256, 200])
+    def test_packed_qkv_matches_unpacked(self, S):
+        """flash_attention_qkv on the fused projection layout must match
+        the split+transpose path exactly, fwd and bwd."""
+        from rocm_apex_tpu.ops.flash_attention import flash_attention_qkv
+
+        B, nh, hd = 2, 2, 128
+        qkv = jax.random.normal(jax.random.PRNGKey(11), (B, S, nh, 3 * hd))
+
+        def unpacked(qkv):
+            q = qkv[..., :hd].transpose(0, 2, 1, 3).reshape(B * nh, S, hd)
+            k = (
+                qkv[..., hd : 2 * hd]
+                .transpose(0, 2, 1, 3)
+                .reshape(B * nh, S, hd)
+            )
+            v = (
+                qkv[..., 2 * hd :]
+                .transpose(0, 2, 1, 3)
+                .reshape(B * nh, S, hd)
+            )
+            o = flash_attention(q, k, v, None, True)
+            return (
+                o.reshape(B, nh, S, hd)
+                .transpose(0, 2, 1, 3)
+                .reshape(B, S, nh * hd)
+            )
+
+        o_p = flash_attention_qkv(qkv, True)
+        o_u = unpacked(qkv)
+        np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_u))
+        g_p = jax.grad(lambda x: jnp.sum(flash_attention_qkv(x, True) ** 2))(
+            qkv
+        )
+        g_u = jax.grad(lambda x: jnp.sum(unpacked(x) ** 2))(qkv)
+        np.testing.assert_allclose(
+            np.asarray(g_p), np.asarray(g_u), rtol=1e-5, atol=1e-5
+        )
+
+    def test_packed_qkv_odd_blocks_cover_tail(self):
+        """Non-default block sizes that do not divide each other's
+        rounding must still process every q row and k column (round-2
+        review: a shared round_up(max(bq,bk)) dropped tail blocks)."""
+        from rocm_apex_tpu.ops.flash_attention import flash_attention_qkv
+
+        B, S, nh, hd = 1, 1024, 1, 128
+        qkv = jax.random.normal(jax.random.PRNGKey(13), (B, S, nh, 3 * hd))
+        o_def = flash_attention_qkv(qkv, True)
+        o_odd = flash_attention_qkv(qkv, True, None, 768, 768)
+        np.testing.assert_allclose(
+            np.asarray(o_odd), np.asarray(o_def), rtol=2e-5, atol=2e-5
+        )
+
     def test_varlen_grads_match_padded(self):
         """flash_attention_varlen gradients == dense per-sequence
         reference gradients on the valid region."""

@@ -244,6 +244,46 @@ class TestVocabParallelCrossEntropy:
         g_ref = jax.grad(ref_loss)(logits, target)
         np.testing.assert_allclose(np.asarray(g_par), np.asarray(g_ref), rtol=1e-5, atol=1e-6)
 
+    def test_bf16_confident_gradient_not_flushed(self):
+        """bf16 logits with a confidently-predicted target (p > 0.998)
+        must keep a non-zero target-entry gradient: probabilities are
+        recomputed in fp32 from saved row stats, never stored as an
+        O(b·s·v) bf16 softmax (round-2 review finding)."""
+        mesh = tp_mesh()
+        b, vocab = 4, 16
+        base = jax.random.normal(jax.random.PRNGKey(0), (b, vocab))
+        target = jnp.zeros((b,), jnp.int32)
+        # push the target logit high: softmax(target) ~ 0.9995+
+        logits = base.at[:, 0].set(12.0).astype(jnp.bfloat16)
+
+        def par_loss(logits, target):
+            def inner(logits, target):
+                local = mappings.scatter_to_tensor_model_parallel_region(
+                    logits
+                )
+                return vocab_parallel_cross_entropy(local, target)
+
+            return jnp.mean(
+                shmap(mesh, inner, (P(), P()), P())(logits, target)
+            )
+
+        def ref_loss(logits, target):
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return jnp.mean(
+                -jnp.take_along_axis(lsm, target[..., None], axis=-1)[..., 0]
+            )
+
+        g_par = jax.grad(par_loss)(logits, target)
+        g_ref = jax.grad(ref_loss)(logits.astype(jnp.float32), target)
+        # target-entry gradient is ~ (p-1)/b ~ -1e-4: must not be 0
+        assert float(jnp.abs(g_par[:, 0].astype(jnp.float32)).max()) > 0.0
+        np.testing.assert_allclose(
+            np.asarray(g_par, np.float32),
+            np.asarray(g_ref),
+            rtol=0.05,
+            atol=1e-6,
+        )
+
 
 class TestBroadcastData:
     def test_broadcast_from_rank0(self):
