@@ -33,9 +33,11 @@ __all__ = [
     "pack_params_and_grads",
     "wd_columns",
     "wd_per_tensor",
+    "wd_tree",
     "per_tensor_to_columns",
     "deltas_to_updates",
     "zero_group_buffers",
+    "zeros_like_f32",
     "tree_where",
     "FusedOptimizer",
 ]
@@ -46,6 +48,47 @@ ScalarOrSchedule = Union[float, jnp.ndarray, Callable]
 def resolve_lr(lr: ScalarOrSchedule, count):
     """Accept a constant or an optax-style schedule step→lr."""
     return lr(count) if callable(lr) else lr
+
+
+def wd_tree(params: Any, weight_decay: float, mask: Optional[Any] = None):
+    """Per-leaf python-float weight decay (True in `mask` = decayed).
+
+    `mask` may be any pytree with the same LEAF COUNT as params (the
+    torch-param-group stand-in contract shared with `wd_columns`)."""
+    if mask is None:
+        return jax.tree_util.tree_map(lambda _: weight_decay, params)
+    p_struct = jax.tree_util.tree_structure(params)
+    m_leaves = jax.tree_util.tree_leaves(mask)
+    if len(m_leaves) != p_struct.num_leaves:
+        raise ValueError(
+            f"weight_decay mask has {len(m_leaves)} leaves, "
+            f"params have {p_struct.num_leaves}"
+        )
+    return jax.tree_util.tree_unflatten(
+        p_struct, [weight_decay if on else 0.0 for on in m_leaves]
+    )
+
+
+def zeros_like_f32(params: Any):
+    """fp32 zero tree shaped like `params` (moment-state init)."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+
+
+def unzip_tree(params: Any, out: Any, n: int) -> Tuple[Any, ...]:
+    """Split a params-shaped tree of n-tuples into n params-shaped trees.
+
+    Container-safe: uses the params treedef to stop flattening at the
+    per-leaf tuples, so params pytrees that themselves contain tuples /
+    NamedTuples (legal JAX containers) unzip correctly — a naive
+    ``is_leaf=lambda x: isinstance(x, tuple)`` would stop at them."""
+    treedef = jax.tree_util.tree_structure(params)
+    flat = treedef.flatten_up_to(out)
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [t[i] for t in flat])
+        for i in range(n)
+    )
 
 
 def pack_params_and_grads(params: Any, grads: Any):

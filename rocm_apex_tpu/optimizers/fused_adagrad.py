@@ -1,17 +1,18 @@
-"""Fused Adagrad over packed buffers.
+"""Fused Adagrad as XLA-tree-fused per-leaf updates.
 
 TPU-native rebuild of `FusedAdagrad` (reference:
 apex/optimizers/fused_adagrad.py:5-121 + csrc/multi_tensor_adagrad.cu:100):
 h += g²; update = g/(√h + eps); `adagrad_w_mode` decouples weight decay
-(reference :30-36).
+(reference :30-36). Tree-fused math, not packed buffers: see
+optimizers/fused_adam.py header for the measured rationale.
 """
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 import optax
 
-from rocm_apex_tpu.ops import optim_kernels
 from rocm_apex_tpu.optimizers import _common as c
 
 __all__ = ["fused_adagrad", "FusedAdagrad", "FusedAdagradState"]
@@ -19,7 +20,7 @@ __all__ = ["fused_adagrad", "FusedAdagrad", "FusedAdagradState"]
 
 class FusedAdagradState(NamedTuple):
     count: jnp.ndarray
-    sum: Tuple[jnp.ndarray, ...]  # fp32 accumulator ("sum" in torch Adagrad)
+    sum: Any  # fp32 accumulator tree ("sum" in torch Adagrad)
 
 
 def fused_adagrad(
@@ -32,30 +33,34 @@ def fused_adagrad(
     grad_scale: Optional[Any] = None,
 ) -> optax.GradientTransformation:
     def init_fn(params):
-        spec = c.build_pack_spec(params)
         return FusedAdagradState(
-            count=jnp.zeros((), jnp.int32), sum=c.zero_group_buffers(spec)
+            count=jnp.zeros((), jnp.int32), sum=c.zeros_like_f32(params)
         )
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("fused_adagrad requires params in update()")
-        spec, pp, pg = c.pack_params_and_grads(params, grads)
         count = state.count + 1
         lr = c.resolve_lr(learning_rate, count)
-        gs = 1.0 if grad_scale is None else grad_scale
-        wd_cols = c.wd_columns(spec, weight_decay, weight_decay_mask)
+        gs = jnp.asarray(
+            1.0 if grad_scale is None else grad_scale, jnp.float32
+        )
+        wd = c.wd_tree(params, weight_decay, weight_decay_mask)
 
-        deltas, new_h = [], []
-        for pbuf, gbuf, hbuf, wd in zip(pp.buffers, pg.buffers, state.sum, wd_cols):
-            d, h2 = optim_kernels.adagrad_update(
-                pbuf, gbuf, hbuf, wd, [lr, eps, gs], adagrad_w_mode
-            )
-            deltas.append(d)
-            new_h.append(h2)
+        def upd(p, g, h, wd):
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32) * gs
+            if not adagrad_w_mode:
+                gf = gf + wd * pf
+            h2 = h + gf * gf
+            u = gf / (jnp.sqrt(h2) + eps)
+            if adagrad_w_mode:
+                u = u + wd * pf
+            return -lr * u, h2
 
-        updates = c.deltas_to_updates(spec, deltas)
-        return updates, FusedAdagradState(count=count, sum=tuple(new_h))
+        out = jax.tree_util.tree_map(upd, params, grads, state.sum, wd)
+        updates, h2 = c.unzip_tree(params, out, 2)
+        return updates, FusedAdagradState(count=count, sum=h2)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

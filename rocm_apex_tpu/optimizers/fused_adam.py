@@ -1,19 +1,30 @@
-"""Fused Adam/AdamW over packed buffers.
+"""Fused Adam/AdamW as XLA-tree-fused per-leaf updates.
 
 TPU-native rebuild of `FusedAdam` (reference:
 apex/optimizers/fused_adam.py:4-173 + csrc/multi_tensor_adam.cu:24-171):
-one Pallas launch per dtype bucket, fp32 math, `adam_w_mode` switching
-between L2 and decoupled decay, optional bias correction, and bf16/fp16
-param support (reference fused_adam.py:134-145 — the ROCm fork's bf16
-path is primary here).
+fp32 math, `adam_w_mode` switching between L2 and decoupled decay,
+optional bias correction, and bf16/fp16 param support (reference
+fused_adam.py:134-145 — the ROCm fork's bf16 path is primary here).
+
+**Why tree-fused math, not the packed Pallas kernels.** The CUDA
+reference packs tensor lists into flat buffers because a kernel launch
+per tensor dominates there (csrc/multi_tensor_apply.cuh). On TPU the
+measured reality is the opposite: (8,128)-tiled arrays do not linearize
+for free, so packing params+grads every step is a ~20 ms/step physical
+relayout on a 134M-param model (optimizers/mixed.py header has the
+numbers), while XLA fuses the whole per-leaf update into a handful of
+bandwidth-bound fusions with zero packing traffic. The packed Pallas
+kernels (ops/optim_kernels.py) remain the substrate where packed layout
+is structurally required — the row-sharded ZeRO optimizers
+(contrib/optimizers/distributed.py).
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import optax
 
-from rocm_apex_tpu.ops import optim_kernels
 from rocm_apex_tpu.optimizers import _common as c
 
 __all__ = ["fused_adam", "FusedAdam", "FusedAdamState"]
@@ -21,8 +32,8 @@ __all__ = ["fused_adam", "FusedAdam", "FusedAdamState"]
 
 class FusedAdamState(NamedTuple):
     count: jnp.ndarray  # i32 step counter
-    m: Tuple[jnp.ndarray, ...]  # fp32 exp_avg group buffers
-    v: Tuple[jnp.ndarray, ...]  # fp32 exp_avg_sq group buffers
+    m: Any  # fp32 exp_avg tree
+    v: Any  # fp32 exp_avg_sq tree
 
 
 def fused_adam(
@@ -42,23 +53,21 @@ def fused_adam(
     (reference: apex/optimizers/fused_adam.py:20-60): `adam_w_mode=True`
     is AdamW (decoupled decay), False folds decay into the gradient.
     `grad_scale` (1/loss_scale) fuses gradient unscaling into the update
-    kernel. `weight_decay_mask` replaces torch param groups for
+    pass. `weight_decay_mask` replaces torch param groups for
     decay-exempting biases/norm params.
     """
     beta1, beta2 = betas
 
     def init_fn(params):
-        spec = c.build_pack_spec(params)
         return FusedAdamState(
             count=jnp.zeros((), jnp.int32),
-            m=c.zero_group_buffers(spec),
-            v=c.zero_group_buffers(spec),
+            m=c.zeros_like_f32(params),
+            v=c.zeros_like_f32(params),
         )
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("fused_adam requires params in update()")
-        spec, pp, pg = c.pack_params_and_grads(params, grads)
         count = state.count + 1
         lr = c.resolve_lr(learning_rate, count)
         t = count.astype(jnp.float32)
@@ -67,28 +76,30 @@ def fused_adam(
             bc2 = 1.0 - beta2**t
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
-        gs = 1.0 if grad_scale is None else grad_scale
-        wd_cols = c.wd_columns(spec, weight_decay, weight_decay_mask)
+        gs = jnp.asarray(
+            1.0 if grad_scale is None else grad_scale, jnp.float32
+        )
+        wd = c.wd_tree(params, weight_decay, weight_decay_mask)
 
-        deltas, new_m, new_v = [], [], []
-        for pbuf, gbuf, mbuf, vbuf, wd in zip(
-            pp.buffers, pg.buffers, state.m, state.v, wd_cols
-        ):
-            d, m2, v2 = optim_kernels.adam_update(
-                pbuf,
-                gbuf,
-                mbuf,
-                vbuf,
-                wd,
-                [lr, beta1, beta2, eps, bc1, bc2, gs],
-                adam_w_mode,
-            )
-            deltas.append(d)
-            new_m.append(m2)
-            new_v.append(v2)
+        def upd(p, g, m, v, wd):
+            # mirrors AdamFunctor (csrc/multi_tensor_adam.cu:24-171),
+            # fp32 in-register math regardless of storage dtype
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32) * gs
+            if not adam_w_mode:  # L2 mode folds decay into the gradient
+                gf = gf + wd * pf
+            m2 = beta1 * m + (1.0 - beta1) * gf
+            v2 = beta2 * v + (1.0 - beta2) * gf * gf
+            u = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+            if adam_w_mode:  # decoupled decay (AdamW)
+                u = u + wd * pf
+            # fp32 delta: optax.apply_updates adds in fp32 and casts
+            # back to the param dtype (same contract as the packed path)
+            return -lr * u, m2, v2
 
-        updates = c.deltas_to_updates(spec, deltas)
-        return updates, FusedAdamState(count=count, m=tuple(new_m), v=tuple(new_v))
+        out = jax.tree_util.tree_map(upd, params, grads, state.m, state.v, wd)
+        updates, m2, v2 = c.unzip_tree(params, out, 3)
+        return updates, FusedAdamState(count=count, m=m2, v=v2)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

@@ -1,21 +1,21 @@
-"""Fused LAMB over packed buffers.
+"""Fused LAMB as XLA-tree-fused per-leaf updates.
 
 TPU-native rebuild of `FusedLAMB` (reference:
 apex/optimizers/fused_lamb.py:4-215 + csrc/multi_tensor_lamb.cu:413):
 global grad-norm clip, Adam-style moment stage, per-tensor trust ratio
 ||p||/||update|| (applied only to decayed tensors unless `use_nvlamb`,
 reference lamb.cu:255-262), grad averaging, both decay modes. The
-reference's per-tensor norms are segmented row reductions here
-(ops/packing.py layout invariant).
+reference's per-tensor norms are per-leaf scalar reductions here.
+Tree-fused math, not packed buffers: see optimizers/fused_adam.py
+header for the measured rationale.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
-from rocm_apex_tpu.ops import optim_kernels
 from rocm_apex_tpu.optimizers import _common as c
 
 __all__ = ["fused_lamb", "FusedLAMB", "FusedLAMBState"]
@@ -23,8 +23,8 @@ __all__ = ["fused_lamb", "FusedLAMB", "FusedLAMBState"]
 
 class FusedLAMBState(NamedTuple):
     count: jnp.ndarray
-    m: Tuple[jnp.ndarray, ...]
-    v: Tuple[jnp.ndarray, ...]
+    m: Any  # fp32 exp_avg tree
+    v: Any  # fp32 exp_avg_sq tree
 
 
 def fused_lamb(
@@ -46,17 +46,15 @@ def fused_lamb(
     beta3 = 1.0 - beta1 if grad_averaging else 1.0
 
     def init_fn(params):
-        spec = c.build_pack_spec(params)
         return FusedLAMBState(
             count=jnp.zeros((), jnp.int32),
-            m=c.zero_group_buffers(spec),
-            v=c.zero_group_buffers(spec),
+            m=c.zeros_like_f32(params),
+            v=c.zeros_like_f32(params),
         )
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("fused_lamb requires params in update()")
-        spec, pp, pg = c.pack_params_and_grads(params, grads)
         count = state.count + 1
         lr = c.resolve_lr(learning_rate, count)
         t = count.astype(jnp.float32)
@@ -65,57 +63,51 @@ def fused_lamb(
             bc2 = 1.0 - beta2**t
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
-        gs = 1.0 if grad_scale is None else grad_scale
+        gs = jnp.asarray(
+            1.0 if grad_scale is None else grad_scale, jnp.float32
+        )
 
-        # global grad norm over every group, then the clip factor
-        # (reference fused_lamb.py:107-137 + lamb.cu:66: grads are divided
-        # by max(||g||/max_norm, 1), i.e. multiplied by our `clip`).
-        from rocm_apex_tpu.ops.multi_tensor import row_sumsq
-
-        gsq = jnp.asarray(0.0, jnp.float32)
-        for gbuf in pg.buffers:
-            gsq = gsq + row_sumsq(gbuf).sum()
+        # global grad norm, then the clip factor (reference
+        # fused_lamb.py:107-137 + lamb.cu:66: grads are divided by
+        # max(||g||/max_norm, 1), i.e. multiplied by our `clip`)
+        gsq = sum(
+            jnp.sum(g.astype(jnp.float32) ** 2)
+            for g in jax.tree_util.tree_leaves(grads)
+        )
         gnorm = jnp.sqrt(gsq) * gs
         if max_grad_norm and max_grad_norm > 0:
             clip = jnp.where(gnorm > max_grad_norm, max_grad_norm / gnorm, 1.0)
         else:
             clip = jnp.asarray(1.0, jnp.float32)
 
-        wd_cols = c.wd_columns(spec, weight_decay, weight_decay_mask)
-        wd_vals = c.wd_per_tensor(spec, weight_decay, weight_decay_mask)
+        wd = c.wd_tree(params, weight_decay, weight_decay_mask)
 
-        deltas, new_m, new_v = [], [], []
-        for pbuf, gbuf, mbuf, vbuf, wd, wdv, group in zip(
-            pp.buffers, pg.buffers, state.m, state.v, wd_cols, wd_vals, spec.groups
-        ):
-            u, m2, v2 = optim_kernels.lamb_stage1(
-                pbuf,
-                gbuf,
-                mbuf,
-                vbuf,
-                wd,
-                [beta1, beta2, beta3, eps, bc1, bc2, gs, clip],
-                adam_w_mode,
-            )
-            # per-tensor trust ratios (reference lamb.cu:243-262):
-            # ratio = ||p|| / ||u|| when both nonzero, only for decayed
-            # tensors unless use_nvlamb.
-            p_norm = jnp.sqrt(c.per_tensor_sumsq(group, pbuf))
-            u_norm = jnp.sqrt(c.per_tensor_sumsq(group, u))
+        def upd(p, g, m, v, wd):
+            # stage 1 (lamb.cu:96-141): un-trust-scaled update direction
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32) * gs * clip
+            if not adam_w_mode:  # MODE_0: decay into the scaled grad
+                gf = gf + wd * pf
+            m2 = beta1 * m + beta3 * gf
+            v2 = beta2 * v + (1.0 - beta2) * gf * gf
+            u = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+            if adam_w_mode:  # MODE_1: decay in the update
+                u = u + wd * pf
+            # stage 2 (lamb.cu:243-262): per-tensor trust ratio
+            # ||p|| / ||u|| when both nonzero, only for decayed tensors
+            # unless use_nvlamb
+            p_norm = jnp.sqrt(jnp.sum(pf * pf))
+            u_norm = jnp.sqrt(jnp.sum(u * u))
             ratio = jnp.where(
                 (p_norm > 0.0) & (u_norm > 0.0), p_norm / u_norm, 1.0
             )
-            if not use_nvlamb:
-                eligible = jnp.asarray(np.asarray(wdv) != 0.0)
-                ratio = jnp.where(eligible, ratio, 1.0)
-            ratio_col = c.per_tensor_to_columns(group, ratio)
-            (d,) = optim_kernels.lamb_stage2(u, ratio_col, [lr])
-            deltas.append(d)
-            new_m.append(m2)
-            new_v.append(v2)
+            if not use_nvlamb and wd == 0.0:
+                ratio = jnp.asarray(1.0, jnp.float32)
+            return -lr * ratio * u, m2, v2
 
-        updates = c.deltas_to_updates(spec, deltas)
-        return updates, FusedLAMBState(count=count, m=tuple(new_m), v=tuple(new_v))
+        out = jax.tree_util.tree_map(upd, params, grads, state.m, state.v, wd)
+        updates, m2, v2 = c.unzip_tree(params, out, 3)
+        return updates, FusedLAMBState(count=count, m=m2, v=v2)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

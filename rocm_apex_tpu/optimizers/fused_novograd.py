@@ -1,11 +1,13 @@
-"""Fused NovoGrad over packed buffers.
+"""Fused NovoGrad as XLA-tree-fused per-leaf updates.
 
 TPU-native rebuild of `FusedNovoGrad` (reference:
 apex/optimizers/fused_novograd.py:4-214 + csrc/multi_tensor_novograd.cu:188):
 per-layer second moment stored as the blended grad *norm* (not squared,
 reference fused_novograd.py:158-177), L2 or inf norm types, `init_zero`
 vs first-step-norm initialization, grad averaging, and both decay
-placements (`reg_inside_moment`).
+placements (`reg_inside_moment`). Per-tensor norms are per-leaf scalar
+reductions here. Tree-fused math, not packed buffers: see
+optimizers/fused_adam.py header for the measured rationale.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -14,8 +16,6 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from rocm_apex_tpu.ops import optim_kernels
-from rocm_apex_tpu.ops.packing import group_segment_ids
 from rocm_apex_tpu.optimizers import _common as c
 
 __all__ = ["fused_novograd", "FusedNovoGrad", "FusedNovoGradState"]
@@ -23,20 +23,8 @@ __all__ = ["fused_novograd", "FusedNovoGrad", "FusedNovoGradState"]
 
 class FusedNovoGradState(NamedTuple):
     count: jnp.ndarray
-    m: Tuple[jnp.ndarray, ...]  # fp32 exp_avg group buffers
-    v: Tuple[jnp.ndarray, ...]  # per-tensor norm vectors, one (n_tensors,) per group
-
-
-def _per_tensor_norm(group, gbuf, norm_type: int) -> jnp.ndarray:
-    if norm_type == 2:
-        return jnp.sqrt(c.per_tensor_sumsq(group, gbuf))
-    # inf norm: segmented max over rows (XLA reduce; the reference computes
-    # this host-side per tensor, fused_novograd.py:168-170)
-    row_max = jnp.max(jnp.abs(gbuf.astype(jnp.float32)), axis=1)
-    seg = jnp.asarray(group_segment_ids(group))
-    return jax.ops.segment_max(
-        row_max, seg, num_segments=len(group.leaf_specs) + 1
-    )[: len(group.leaf_specs)]
+    m: Any  # fp32 exp_avg tree
+    v: Any  # per-tensor norm scalars, tree of () fp32
 
 
 def fused_novograd(
@@ -61,19 +49,17 @@ def fused_novograd(
     beta3 = 1.0 - beta1 if grad_averaging else 1.0
 
     def init_fn(params):
-        spec = c.build_pack_spec(params)
         return FusedNovoGradState(
             count=jnp.zeros((), jnp.int32),
-            m=c.zero_group_buffers(spec),
-            v=tuple(
-                jnp.zeros((len(g.leaf_specs),), jnp.float32) for g in spec.groups
+            m=c.zeros_like_f32(params),
+            v=jax.tree_util.tree_map(
+                lambda _: jnp.zeros((), jnp.float32), params
             ),
         )
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("fused_novograd requires params in update()")
-        spec, pp, pg = c.pack_params_and_grads(params, grads)
         count = state.count + 1
         lr = c.resolve_lr(learning_rate, count)
         t = count.astype(jnp.float32)
@@ -85,8 +71,10 @@ def fused_novograd(
             bc2 = jnp.sqrt(1.0 - beta2**t)
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
-        gs = 1.0 if grad_scale is None else grad_scale
-        wd_cols = c.wd_columns(spec, weight_decay, weight_decay_mask)
+        gs = jnp.asarray(
+            1.0 if grad_scale is None else grad_scale, jnp.float32
+        )
+        wd = c.wd_tree(params, weight_decay, weight_decay_mask)
 
         def blend(old, new):
             # EMA of *norms*: L2 blends in squared space, inf linearly
@@ -96,36 +84,32 @@ def fused_novograd(
                 return jnp.sqrt(beta2 * old * old + (1.0 - beta2) * new * new)
             return beta2 * old + (1.0 - beta2) * new
 
-        deltas, new_m, new_v = [], [], []
-        for pbuf, gbuf, mbuf, vvec, wd, group in zip(
-            pp.buffers, pg.buffers, state.m, state.v, wd_cols, spec.groups
-        ):
-            norm = _per_tensor_norm(group, gbuf, norm_type) * gs
-            if init_zero:
-                v2 = blend(vvec, norm)
+        def upd(p, g, m, vscalar, wd):
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            if norm_type == 2:
+                norm = jnp.sqrt(jnp.sum(gf * gf)) * gs
             else:
-                # first step seeds v with the raw norm "so first blend has
-                # no effect" (reference fused_novograd.py:167); later steps
-                # blend.
-                v2 = jnp.where(count == 1, norm, blend(vvec, norm))
-            v_col = c.per_tensor_to_columns(group, v2)
-            d, m2 = optim_kernels.novograd_update(
-                pbuf,
-                gbuf,
-                mbuf,
-                v_col,
-                wd,
-                [lr, beta1, beta3, eps, bc1, bc2, gs],
-                reg_inside_moment,
-            )
-            deltas.append(d)
-            new_m.append(m2)
-            new_v.append(v2)
+                norm = jnp.max(jnp.abs(gf)) * gs
+            if init_zero:
+                v2 = blend(vscalar, norm)
+            else:
+                # first step seeds v with the raw norm "so first blend
+                # has no effect" (reference fused_novograd.py:167)
+                v2 = jnp.where(count == 1, norm, blend(vscalar, norm))
+            gf = gf * gs
+            denom = v2 / bc2 + eps
+            if reg_inside_moment:  # MOMENT_MODE_0 (novograd.cu:99-105)
+                m2 = beta1 * m + beta3 * (gf / denom + wd * pf)
+                d = -lr * (m2 / bc1)
+            else:  # MOMENT_MODE_1, decoupled decay (:107-114)
+                m2 = beta1 * m + beta3 * gf
+                d = -lr * ((m2 / bc1) / denom + wd * pf)
+            return d, m2, v2
 
-        updates = c.deltas_to_updates(spec, deltas)
-        return updates, FusedNovoGradState(
-            count=count, m=tuple(new_m), v=tuple(new_v)
-        )
+        out = jax.tree_util.tree_map(upd, params, grads, state.m, state.v, wd)
+        updates, m2, v2 = c.unzip_tree(params, out, 3)
+        return updates, FusedNovoGradState(count=count, m=m2, v=v2)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

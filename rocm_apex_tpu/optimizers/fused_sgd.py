@@ -1,4 +1,4 @@
-"""Fused SGD over packed buffers.
+"""Fused SGD as XLA-tree-fused per-leaf updates.
 
 TPU-native rebuild of `FusedSGD` (reference:
 apex/optimizers/fused_sgd.py:6-227 + csrc/multi_tensor_sgd_kernel.cu:322):
@@ -7,15 +7,16 @@ first-momentum-step semantics (buf = d on the first application) and the
 `wd_after_momentum` placement option. The reference's depth-3 variant
 (materializing an fp16 model copy in-kernel for amp master weights) is
 covered by the amp layer's master-weight wrapper instead
-(rocm_apex_tpu/amp/_process_optimizer.py).
+(rocm_apex_tpu/amp/_process_optimizer.py). Tree-fused math, not packed
+buffers: see optimizers/fused_adam.py header for the measured rationale.
 """
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 import optax
 
-from rocm_apex_tpu.ops import optim_kernels
 from rocm_apex_tpu.optimizers import _common as c
 
 __all__ = ["fused_sgd", "FusedSGD", "FusedSGDState"]
@@ -23,7 +24,7 @@ __all__ = ["fused_sgd", "FusedSGD", "FusedSGDState"]
 
 class FusedSGDState(NamedTuple):
     count: jnp.ndarray
-    momentum_buffer: Tuple[jnp.ndarray, ...]  # fp32 group buffers
+    momentum_buffer: Any  # fp32 tree
 
 
 def fused_sgd(
@@ -42,41 +43,44 @@ def fused_sgd(
         raise ValueError("Nesterov momentum requires a momentum and zero dampening")
 
     def init_fn(params):
-        spec = c.build_pack_spec(params)
         return FusedSGDState(
             count=jnp.zeros((), jnp.int32),
-            momentum_buffer=c.zero_group_buffers(spec),
+            momentum_buffer=c.zeros_like_f32(params),
         )
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("fused_sgd requires params in update()")
-        spec, pp, pg = c.pack_params_and_grads(params, grads)
         count = state.count + 1
         lr = c.resolve_lr(learning_rate, count)
-        first = (state.count == 0).astype(jnp.float32)
-        gs = 1.0 if grad_scale is None else grad_scale
-        wd_cols = c.wd_columns(spec, weight_decay, weight_decay_mask)
+        first = state.count == 0
+        gs = jnp.asarray(
+            1.0 if grad_scale is None else grad_scale, jnp.float32
+        )
+        wd = c.wd_tree(params, weight_decay, weight_decay_mask)
 
-        deltas, new_buf = [], []
-        for pbuf, gbuf, mbuf, wd in zip(
-            pp.buffers, pg.buffers, state.momentum_buffer, wd_cols
-        ):
-            d, b2 = optim_kernels.sgd_update(
-                pbuf,
-                gbuf,
-                mbuf,
-                wd,
-                [lr, momentum, dampening, first, gs],
-                nesterov,
-                wd_after_momentum,
-                momentum != 0.0,
-            )
-            deltas.append(d)
-            new_buf.append(b2)
+        def upd(p, g, mbuf, wd):
+            # mirrors the sgd functor (csrc/multi_tensor_sgd_kernel.cu):
+            # first momentum application sets buf = d
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32) * gs
+            if not wd_after_momentum:
+                gf = gf + wd * pf
+            if momentum != 0.0:
+                buf = jnp.where(
+                    first, gf, momentum * mbuf + (1.0 - dampening) * gf
+                )
+                d = gf + momentum * buf if nesterov else buf
+            else:
+                buf = mbuf
+                d = gf
+            if wd_after_momentum:
+                d = d + wd * pf
+            return -lr * d, buf
 
-        updates = c.deltas_to_updates(spec, deltas)
-        return updates, FusedSGDState(count=count, momentum_buffer=tuple(new_buf))
+        out = jax.tree_util.tree_map(upd, params, grads, state.momentum_buffer, wd)
+        updates, buf = c.unzip_tree(params, out, 2)
+        return updates, FusedSGDState(count=count, momentum_buffer=buf)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
