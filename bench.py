@@ -58,6 +58,181 @@ def peak_flops_per_chip() -> float:
     return 1e12
 
 
+def _report(metric, value, unit, vs_baseline, extra=""):
+    print(extra, file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 1),
+                "unit": unit,
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+def bench_rn50():
+    """BASELINE.json config 2: ResNet-50, O5 recipe (bf16 + fp32
+    masters via amp.initialize) + FusedAdam, images/sec/chip.
+    DDP-equivalent gradient psum degenerates on one chip (the
+    multi-chip path is exercised by tests/L0/test_parallel.py)."""
+    import optax
+
+    from rocm_apex_tpu import amp, models
+    from rocm_apex_tpu.optimizers import FusedAdam
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = 128 if on_tpu else 4  # b128 beats b64 by 16% img/s on v5e
+    size = 224 if on_tpu else 32
+    iters = 20 if on_tpu else 2
+    model = models.resnet50(num_classes=1000)
+    x0 = jnp.zeros((batch, size, size, 3))
+    variables = model.init(jax.random.PRNGKey(0), x0)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    optimizer = FusedAdam(1e-3, weight_decay=1e-4)
+    params, optimizer, amp_state = amp.initialize(
+        params, optimizer, opt_level="O5" if on_tpu else "O0"
+    )
+    opt_state = optimizer.init(params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, size, size, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
+
+    def one_step(carry, _):
+        params, batch_stats, opt_state, scaler_states = carry
+        st = amp_state.replace(scaler_states=scaler_states)
+
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                x.astype(jnp.bfloat16 if on_tpu else jnp.float32),
+                mutable=["batch_stats"],
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y
+            ).mean()
+            return amp.scale_loss(ce, st), (mut["batch_stats"], ce)
+
+        (_, (bs2, ce)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        grads, found_inf = amp.unscale_grads(grads, st)
+        st2, skip = amp.update_scale(st, found_inf)
+        updates, opt2 = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params = amp.skip_step(skip, new_params, params)
+        opt2 = amp.skip_step(skip, opt2, opt_state)
+        return (new_params, bs2, opt2, st2.scaler_states), ce
+
+    @jax.jit
+    def runN(params, batch_stats, opt_state, scaler_states):
+        carry, ces = jax.lax.scan(
+            one_step,
+            (params, batch_stats, opt_state, scaler_states),
+            None,
+            length=iters,
+        )
+        return carry, ces
+
+    carry, ces = runN(params, batch_stats, opt_state, amp_state.scaler_states)
+    float(ces[-1])
+    t0 = time.perf_counter()
+    carry, ces = runN(*carry)
+    loss = float(ces[-1])
+    dt = (time.perf_counter() - t0) / iters
+    img_s = batch / dt
+    # RN50 train ~ 3 x 4.1 GFLOPs fwd per image at 224x224
+    mfu = (12.3e9 * batch / dt) / peak_flops_per_chip()
+    _report(
+        "rn50_train_images_per_sec_per_chip", img_s, "images/s", mfu / 0.70,
+        f"rn50: step={dt*1000:.1f}ms loss={loss:.3f} mfu={mfu:.3f}",
+    )
+
+
+def bench_bert():
+    """BASELINE.json config 4: BERT-Large-shaped MLM pretrain step with
+    FusedLAMB + fused LayerNorm, tokens/sec/chip. 24L/1024h with
+    head_dim 128 (the TPU-first head shape; see main())."""
+    from rocm_apex_tpu.models import BertConfig, BertModel
+    from rocm_apex_tpu.optimizers import fused_lamb
+    from rocm_apex_tpu.utils.tree import path_str
+
+    on_tpu = jax.default_backend() == "tpu"
+    # b8 exhausts the 16 GB chip (330M params x fp32 p/m/v double-
+    # buffered through the scan carry + activations); b4 fits
+    batch = 4 if on_tpu else 2
+    seq = 512 if on_tpu else 64
+    iters = 20 if on_tpu else 2
+    cfg = BertConfig(
+        vocab_size=30592 if on_tpu else 1024,
+        hidden_size=1024 if on_tpu else 64,
+        num_layers=24 if on_tpu else 2,
+        num_attention_heads=8 if on_tpu else 4,
+        ffn_hidden_size=4096 if on_tpu else 128,
+        max_position_embeddings=seq,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        tensor_parallel_size=1,
+    )
+    model = BertModel(cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size
+    )
+    lm_labels = jnp.roll(tokens, 1, axis=1)
+    params = model.init(jax.random.PRNGKey(1), tokens[:1])
+    flat = jax.tree_util.tree_map_with_path(
+        lambda kp, _: not (
+            path_str(kp).endswith("bias") or "layernorm" in path_str(kp).lower()
+        ),
+        params,
+    )
+    opt = fused_lamb(1e-4, weight_decay=0.01, weight_decay_mask=flat)
+    opt_state = opt.init(params)
+
+    def one_step(carry, _):
+        params, opt_state = carry
+
+        def loss_fn(p):
+            losses, _ = model.apply(p, tokens, lm_labels=lm_labels)
+            return jnp.mean(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        params2 = jax.tree_util.tree_map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+            params,
+            updates,
+        )
+        return (params2, opt_state2), loss
+
+    @jax.jit
+    def runN(params, opt_state):
+        carry, losses = jax.lax.scan(
+            one_step, (params, opt_state), None, length=iters
+        )
+        return carry, losses
+
+    carry, losses = runN(params, opt_state)
+    float(losses[-1])
+    t0 = time.perf_counter()
+    carry, losses = runN(*carry)
+    loss = float(losses[-1])
+    dt = (time.perf_counter() - t0) / iters
+    tok_s = batch * seq / dt
+    n_params = sum(
+        int(x.size) for x in jax.tree_util.tree_leaves(params)
+    ) - cfg.vocab_size * cfg.hidden_size
+    flops = 6.0 * n_params * batch * seq + (
+        12.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
+    )
+    mfu = (flops / dt) / peak_flops_per_chip()
+    _report(
+        "bert_large_train_tokens_per_sec_per_chip", tok_s, "tokens/s",
+        mfu / 0.70,
+        f"bert: step={dt*1000:.1f}ms loss={loss:.3f} mfu={mfu:.3f}",
+    )
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
     # head_dim = hidden/heads = 128 = the MXU lane width. hd=64 pads
@@ -145,4 +320,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # driver contract: plain `python bench.py` = the flagship GPT line.
+    # `python bench.py rn50|bert` measures the other BASELINE.json
+    # configs (results recorded in BASELINE.md).
+    benches = {"gpt": main, "rn50": bench_rn50, "bert": bench_bert}
+    which = sys.argv[1] if len(sys.argv) > 1 else "gpt"
+    if which not in benches:
+        raise SystemExit(
+            f"unknown benchmark {which!r}; choose from {sorted(benches)}"
+        )
+    benches[which]()

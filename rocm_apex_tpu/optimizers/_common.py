@@ -36,6 +36,7 @@ __all__ = [
     "wd_tree",
     "per_tensor_to_columns",
     "deltas_to_updates",
+    "unzip_tree",
     "zero_group_buffers",
     "zeros_like_f32",
     "tree_where",

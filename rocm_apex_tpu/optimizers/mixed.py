@@ -135,15 +135,9 @@ class MixedPrecisionAdam:
             live = 1.0 - jnp.asarray(skip, jnp.float32)
             count = state.count + live.astype(jnp.int32)
 
-        if self.weight_decay_mask is None:
-            wd_tree = jax.tree_util.tree_map(
-                lambda _: self.weight_decay, state.master
-            )
-        else:
-            wd_tree = jax.tree_util.tree_map(
-                lambda on: self.weight_decay if on else 0.0,
-                self.weight_decay_mask,
-            )
+        wd_tree = c.wd_tree(
+            state.master, self.weight_decay, self.weight_decay_mask
+        )
 
         def upd(p, g, m, v, wd):
             gf = g.astype(jnp.float32) * gs
@@ -167,18 +161,15 @@ class MixedPrecisionAdam:
         out = jax.tree_util.tree_map(
             upd, state.master, grads, state.m, state.v, wd_tree
         )
-        tup = lambda i: jax.tree_util.tree_map(  # noqa: E731
-            lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple)
-        )
-        master2 = tup(0)
+        master2, m2, v2 = c.unzip_tree(state.master, out, 3)
         return MixedPrecisionState(
             count=count,
             model=jax.tree_util.tree_map(
                 lambda x: x.astype(self.compute_dtype), master2
             ),
             master=master2,
-            m=tup(1),
-            v=tup(2),
+            m=m2,
+            v=v2,
         )
 
     def step_and_probe(
@@ -210,15 +201,9 @@ class MixedPrecisionAdam:
         gs = jnp.asarray(
             1.0 if grad_scale is None else grad_scale, jnp.float32
         )
-        if self.weight_decay_mask is None:
-            wd_tree = jax.tree_util.tree_map(
-                lambda _: self.weight_decay, state.master
-            )
-        else:
-            wd_tree = jax.tree_util.tree_map(
-                lambda on: self.weight_decay if on else 0.0,
-                self.weight_decay_mask,
-            )
+        wd_tree = c.wd_tree(
+            state.master, self.weight_decay, self.weight_decay_mask
+        )
 
         def upd(p, g, m, v, wd):
             gf = g.astype(jnp.float32) * gs
@@ -235,12 +220,12 @@ class MixedPrecisionAdam:
         out = jax.tree_util.tree_map(
             upd, state.master, grads, state.m, state.v, wd_tree
         )
-        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
-        tup = lambda i: jax.tree_util.tree_map(  # noqa: E731
-            lambda o: o[i], out, is_leaf=is_tup
+        new_master, new_m, new_v, probes = c.unzip_tree(
+            state.master, out, 4
         )
-        probes = jax.tree_util.tree_leaves(tup(3))
-        found_inf = ~jnp.isfinite(sum(probes))
+        found_inf = ~jnp.isfinite(
+            sum(jax.tree_util.tree_leaves(probes))
+        )
         ok = ~found_inf
 
         def sel(new, old):
@@ -248,14 +233,14 @@ class MixedPrecisionAdam:
                 lambda n, o: jnp.where(ok, n, o), new, old
             )
 
-        master2 = sel(tup(0), state.master)
+        master2 = sel(new_master, state.master)
         new_state = MixedPrecisionState(
             count=state.count + ok.astype(jnp.int32),
             model=jax.tree_util.tree_map(
                 lambda x: x.astype(self.compute_dtype), master2
             ),
             master=master2,
-            m=sel(tup(1), state.m),
-            v=sel(tup(2), state.v),
+            m=sel(new_m, state.m),
+            v=sel(new_v, state.v),
         )
         return new_state, found_inf
