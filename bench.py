@@ -302,20 +302,11 @@ def main():
         12.0 * cfg.num_layers * BATCH * seq * seq * cfg.hidden_size
     )
     mfu = (model_flops / dt) / peak_flops_per_chip()
-    print(
+    _report(
+        "gpt_train_tokens_per_sec_per_chip", tokens_per_sec, "tokens/s",
+        mfu / 0.70,
         f"step={dt*1000:.1f}ms loss={loss:.4f} mfu={mfu:.3f} "
         f"backend={jax.default_backend()}",
-        file=sys.stderr,
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "gpt_train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(mfu / 0.70, 4),
-            }
-        )
     )
 
 

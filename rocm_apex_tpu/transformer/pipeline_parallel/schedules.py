@@ -263,6 +263,200 @@ def forward_backward_no_pipelining(
     return losses, grads
 
 
+def _tree_idx(tree, i):
+    return jax.tree_util.tree_map(lambda a: a[i], tree)
+
+
+def _one_pass_1f1b(
+    stage_fn, loss_fn, local_params, inputs, targets, axis,
+    extra, pre_fn, has_extra,
+):
+    """True 1F1B: ONE non-differentiated scan interleaving a forward
+    and a backward unit per tick, with O(P) live activations.
+
+    Differentiating a forward scan (the previous implementation) saves
+    the carried activation at EVERY tick for the transpose — O(M)
+    memory, defeating 1F1B's point. Here gradients are constructed
+    inside the scan instead (reference semantics:
+    fwd_bwd_pipelining_without_interleaving.py:22-170):
+
+    * tick ``t``, rank ``s``: forward of microbatch ``jf = t − s`` and
+      backward of ``jb = t − (2(P−1) − s)`` — the exit stage backwards
+      a microbatch the same tick it forwards it, stage 0 a full
+      2(P−1) ticks later: exactly the reference's warmup/steady/
+      cooldown profile, as validity masks;
+    * stage INPUTS wait in a circular buffer of ``2(P−1)`` slots (the
+      1F1B in-flight bound; the exit stage stores nothing) and the
+      backward unit rematerializes the stage forward from the saved
+      input via `jax.vjp` — same recompute count as the old
+      checkpointed transpose, without its O(ticks) carry history;
+    * activation cotangents ride a REVERSE ppermute; the exit stage
+      seeds them from the head/loss VJP (cotangent 1/M = the mean);
+      shared-param (embedding/head) cotangents accumulate on the
+      ranks that own those computations and are psum'd by the caller.
+
+    Gradients accumulate in fp32 and are cast to the param dtype at
+    the end. Returns (losses (M,), grads, extra_grads | None).
+    """
+    p = jax.lax.axis_size(axis)
+    m = inputs.shape[0]
+    rank = jax.lax.axis_index(axis)
+    is_first = rank == 0
+    is_last = rank == p - 1
+    fwd_perm = [(i, i + 1) for i in range(p - 1)]
+    bwd_perm = [(i + 1, i) for i in range(p - 1)]
+    nslots = max(1, 2 * (p - 1))
+    ticks = m + 2 * (p - 1)
+
+    in0 = jax.eval_shape(lambda x: x[0], inputs)
+    if pre_fn is None:
+        a0 = in0
+    else:
+        a0 = jax.eval_shape(pre_fn, extra, in0)
+
+    def varying(x):
+        return jax.tree_util.tree_map(lambda v: _pcast_varying(v, axis), x)
+
+    def zeros_of(shape_tree, dtype=None):
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, dtype or s.dtype), shape_tree
+        )
+
+    def tick(carry, t):
+        act_recv, ct_recv, x_buf, g_acc, eg_acc, losses = carry
+
+        # ---- forward unit: microbatch jf -------------------------------
+        jf = t - rank
+        fwd_valid = (jf >= 0) & (jf < m)
+        jf_c = jnp.clip(jf, 0, m - 1)
+        inp_j = _tree_idx(inputs, jf_c)
+        x0 = pre_fn(extra, inp_j) if pre_fn is not None else inp_j
+        x_in = jnp.where(is_first, _pcast_varying(x0, axis), act_recv)
+        y = stage_fn(local_params, x_in)
+
+        # exit-stage post_process: loss value + initial cotangent dy,
+        # under a rank cond (non-exit ranks never run or differentiate
+        # the head — see _head_losses for why cond, not select). The
+        # extra-grad accumulator threads THROUGH the cond so the
+        # full-embedding-sized add happens only on the exit rank's
+        # valid ticks (outside, every rank would add a zero tree the
+        # size of the embedding every tick).
+        tgt_j = _tree_idx(targets, jf_c)
+        ct1 = _pcast_varying(jnp.asarray(1.0 / m, jnp.float32), axis)
+
+        def _head():
+            if has_extra:
+                def lf(e, yy):
+                    return loss_fn(e, yy, tgt_j).astype(jnp.float32)
+
+                loss, pull = jax.vjp(lf, extra, y)
+                de, dy = pull(ct1)
+                eg2 = jax.tree_util.tree_map(
+                    lambda a, d: a + d.astype(jnp.float32), eg_acc, de
+                )
+                return varying((loss, dy)), eg2
+
+            def lf(yy):
+                return loss_fn(yy, tgt_j).astype(jnp.float32)
+
+            loss, pull = jax.vjp(lf, y)
+            (dy,) = pull(ct1)
+            return varying((loss, dy)), eg_acc
+
+        def _nohead():
+            return (
+                varying(
+                    (
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros(y.shape, y.dtype),
+                    )
+                ),
+                eg_acc,
+            )
+
+        (loss_j, dy), eg_acc = jax.lax.cond(
+            is_last & fwd_valid, _head, _nohead
+        )
+        losses = losses.at[jf_c].set(
+            jnp.where(is_last & fwd_valid, loss_j, losses[jf_c])
+        )
+
+        # ---- backward unit: microbatch jb ------------------------------
+        jb = t - (2 * (p - 1) - rank)
+        bwd_valid = (jb >= 0) & (jb < m)
+        jb_c = jnp.clip(jb, 0, m - 1)
+        slot_b = jb_c % nslots
+        # the exit stage backwards the microbatch it just forwarded
+        # (its lifetime is zero — no buffer slot ever written there)
+        x_saved = jnp.where(is_last, x_in, x_buf[slot_b])
+        ct_in = jnp.where(is_last, dy.astype(y.dtype), ct_recv)
+        _, pull = jax.vjp(stage_fn, local_params, x_saved)
+        dp_j, dx_j = pull(ct_in)
+        g_acc = jax.tree_util.tree_map(
+            lambda a, d: a + jnp.where(
+                bwd_valid, d.astype(jnp.float32), 0.0
+            ),
+            g_acc,
+            dp_j,
+        )
+
+        # entry-stage pre_process backward (embedding cotangents),
+        # accumulator threaded through the cond for the same reason
+        if has_extra and pre_fn is not None:
+            inp_b = _tree_idx(inputs, jb_c)
+
+            def _pre_bwd():
+                _, pullE = jax.vjp(lambda e: pre_fn(e, inp_b), extra)
+                (deE,) = pullE(dx_j)
+                return jax.tree_util.tree_map(
+                    lambda a, d: a + d.astype(jnp.float32), eg_acc, deE
+                )
+
+            eg_acc = jax.lax.cond(
+                is_first & bwd_valid, _pre_bwd, lambda: eg_acc
+            )
+
+        # ---- buffer + ring transfers ----------------------------------
+        slot_f = jf_c % nslots
+        x_buf = x_buf.at[slot_f].set(
+            jnp.where(fwd_valid & ~is_last, x_in, x_buf[slot_f])
+        )
+        act_send = jax.lax.ppermute(y, axis, fwd_perm)
+        ct_send = jax.lax.ppermute(
+            jnp.where(bwd_valid, dx_j, jnp.zeros_like(dx_j)),
+            axis,
+            bwd_perm,
+        )
+        return (act_send, ct_send, x_buf, g_acc, eg_acc, losses), None
+
+    act0 = varying(jnp.zeros(a0.shape, a0.dtype))
+    ct0 = varying(jnp.zeros(a0.shape, a0.dtype))
+    xbuf0 = varying(jnp.zeros((nslots,) + a0.shape, a0.dtype))
+    g0 = varying(zeros_of(local_params, jnp.float32))
+    eg0 = varying(zeros_of(extra, jnp.float32)) if has_extra else ()
+    losses0 = varying(jnp.zeros((m,), jnp.float32))
+
+    (_, _, _, g_acc, eg_acc, losses), _ = jax.lax.scan(
+        tick,
+        (act0, ct0, xbuf0, g0, eg0, losses0),
+        jnp.arange(ticks),
+    )
+    grads = jax.tree_util.tree_map(
+        lambda g, pp: g.astype(pp.dtype), g_acc, local_params
+    )
+    losses = _replicate_masked(
+        losses, is_last.astype(losses.dtype), axis
+    )
+    if has_extra:
+        egrads = jax.tree_util.tree_map(
+            lambda g, e: jax.lax.psum(g, axis).astype(e.dtype),
+            eg_acc,
+            extra,
+        )
+        return losses, grads, egrads
+    return losses, grads, None
+
+
 def forward_backward_pipelining_without_interleaving(
     stage_fn: StageFn,
     loss_fn: LossFn,
@@ -277,14 +471,17 @@ def forward_backward_pipelining_without_interleaving(
     pre_fn=None,
     **unused_kw,
 ):
-    """The 1F1B-equivalent linear pipeline.
+    """The 1F1B linear pipeline.
 
     reference: fwd_bwd_pipelining_without_interleaving.py:22-170. Tick
     ``t`` has stage ``s`` working on microbatch ``t−s``; with M
-    microbatches the scan runs M+P−1 ticks. The reference's warmup
-    (P−rank−1 forwards), steady 1F1B and cooldown are the lower/upper
-    triangles of the (tick, stage) diagram and need no code; backward
-    order comes from the scan transpose.
+    microbatches the forward spans M+P−1 ticks. Training runs the
+    one-pass interleaved schedule (`_one_pass_1f1b` — O(P) live
+    activations, gradients built inside the scan); `forward_only`
+    keeps the plain forward scan. ``checkpoint_stages`` is accepted
+    for API compatibility: the one-pass backward always rematerializes
+    the stage from its saved input, which is the same recompute the
+    checkpointed transpose performed.
     """
     axis = axis_name or parallel_state.PIPE_AXIS
     p = jax.lax.axis_size(axis)
@@ -345,30 +542,18 @@ def forward_backward_pipelining_without_interleaving(
     if forward_only:
         _, losses = run(local_params, extra_params)
         return losses, None
-    if has_extra:
-        (_, losses), (grads, egrads) = jax.value_and_grad(
-            run, argnums=(0, 1), has_aux=True
-        )(local_params, extra_params)
-        # Shared-param grads are per-stage partials (stage 0 holds the
-        # pre_fn/embedding path, stage P-1 the loss-head path): sum over
-        # the axis — the reference's embedding-group allreduce
-        # (parallel_state embedding group = first + last stage).
-        egrads = jax.lax.psum(
-            jax.tree_util.tree_map(
-                lambda g: _pcast_varying(g, axis), egrads
-            ),
-            axis,
-        )
-        grads = jax.tree_util.tree_map(
-            lambda g, x: g[None] if x.shape[:1] == (1,) else g, grads, params
-        )
-        return losses, (grads, egrads)
-    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(
-        local_params, extra_params
+    losses, grads, egrads = _one_pass_1f1b(
+        stage_fn, loss_fn, local_params, inputs, targets, axis,
+        extra_params, pre_fn, has_extra,
     )
     grads = jax.tree_util.tree_map(
         lambda g, x: g[None] if x.shape[:1] == (1,) else g, grads, params
     )
+    if has_extra:
+        # egrads are per-stage partials summed over the axis inside
+        # _one_pass_1f1b — the reference's embedding-group allreduce
+        # (parallel_state embedding group = first + last stage)
+        return losses, (grads, egrads)
     return losses, grads
 
 
