@@ -60,7 +60,10 @@ def main():
         attention_dropout=0.0,
         tensor_parallel_size=tp,
         init_method_std=args.init_method_std,
-        checkpoint_activations=args.checkpoint_activations,
+        # the argument system migrates --checkpoint-activations to
+        # activations_checkpoint_method='uniform' (reference semantics)
+        checkpoint_activations=args.activations_checkpoint_method
+        is not None,
     )
     model = GPTModel(cfg)
     opt = MixedPrecisionAdam(args.lr, weight_decay=args.weight_decay)

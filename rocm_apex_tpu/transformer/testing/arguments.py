@@ -1,17 +1,23 @@
-"""Megatron-style argument system.
+"""Megatron-style argument system — the full schema.
 
-Rebuild of the reference's de-facto config schema
-(reference: apex/transformer/testing/arguments.py, 806 LoC — the full
-Megatron argparser grouped as model/regularization/training/
-initialization/learning-rate/checkpointing/mixed-precision/distributed/
-validation/data groups, with `parse_args(extra_args_provider,
-defaults, ignore_unknown_args)` and post-parse consistency checks).
+Rebuild of the reference's de-facto config surface (reference:
+apex/transformer/testing/arguments.py, 806 LoC): every flag group
+(network size, logging, regularization, training, initialization,
+learning rate, checkpointing, mixed precision, distributed, validation,
+data, autoresume, biencoder, vit), the deprecated-flag rejections, the
+``--checkpoint-activations`` migration, and the full post-parse
+validation web — so downstream Megatron-style launch scripts parse
+unchanged.
 
-This carries the same group structure and the flags the framework
-consumes; CUDA-only knobs keep their names where downstream scripts
-pass them (accepted, unused) and are marked so. Consistency checks
-mirror the reference's (world-size divisibility, fp16/bf16 exclusivity,
-virtual-pipeline constraints).
+TPU adaptations (each marked at its flag):
+* ``world_size`` defaults to `jax.device_count()` when WORLD_SIZE is
+  unset (single-controller JAX has no torch.distributed env);
+* ``params_dtype`` is a jnp dtype;
+* CUDA-only knobs (NCCL backend names, contiguous DDP buffers, CUDA
+  empty-cache levels, tensorboard plumbing) are accepted-unused for
+  script compatibility;
+* validation failures raise ``ValueError`` with the reference's
+  message text (the reference uses bare asserts).
 """
 
 import argparse
@@ -20,12 +26,18 @@ import os
 __all__ = ["parse_args"]
 
 
+def _fail(cond, message):
+    if not cond:
+        raise ValueError(message)
+
+
 def parse_args(extra_args_provider=None, defaults=None,
                ignore_unknown_args=False, args=None):
+    """Parse all arguments (reference arguments.py:23-260)."""
     parser = argparse.ArgumentParser(
         description="rocm_apex_tpu Arguments", allow_abbrev=False
     )
-    _add_model_config_args(parser)
+    _add_network_size_args(parser)
     _add_regularization_args(parser)
     _add_training_args(parser)
     _add_initialization_args(parser)
@@ -35,6 +47,10 @@ def parse_args(extra_args_provider=None, defaults=None,
     _add_distributed_args(parser)
     _add_validation_args(parser)
     _add_data_args(parser)
+    _add_autoresume_args(parser)
+    _add_biencoder_args(parser)
+    _add_vit_args(parser)
+    _add_logging_args(parser)
     if extra_args_provider is not None:
         parser = extra_args_provider(parser)
 
@@ -43,67 +59,300 @@ def parse_args(extra_args_provider=None, defaults=None,
     else:
         parsed = parser.parse_args(args)
 
+    # ---- distributed sizes (reference :55-88). WORLD_SIZE wins when
+    # set (launcher compatibility); otherwise the visible device count.
+    import jax
+
+    parsed.rank = int(os.getenv("RANK", "0"))
+    parsed.world_size = int(
+        os.environ.get("WORLD_SIZE", jax.device_count())
+    )
+    parsed.tensor_model_parallel_size = min(
+        parsed.tensor_model_parallel_size, parsed.world_size
+    )
+    _fail(
+        parsed.world_size % parsed.tensor_model_parallel_size == 0,
+        "world size ({}) is not divisible by tensor model parallel size "
+        "({})".format(parsed.world_size, parsed.tensor_model_parallel_size),
+    )
+    parsed.pipeline_model_parallel_size = min(
+        parsed.pipeline_model_parallel_size,
+        parsed.world_size // parsed.tensor_model_parallel_size,
+    )
+    model_parallel_size = (
+        parsed.pipeline_model_parallel_size
+        * parsed.tensor_model_parallel_size
+    )
+    _fail(
+        parsed.world_size % model_parallel_size == 0,
+        "world size is not divisible by tensor parallel size ({}) times "
+        "pipeline parallel size ({})".format(
+            parsed.tensor_model_parallel_size,
+            parsed.pipeline_model_parallel_size,
+        ),
+    )
+    parsed.data_parallel_size = parsed.world_size // model_parallel_size
+    if parsed.pipeline_model_parallel_size > 1:
+        if parsed.pipeline_model_parallel_split_rank is not None:
+            _fail(
+                parsed.pipeline_model_parallel_split_rank
+                < parsed.pipeline_model_parallel_size,
+                "split rank needs to be less than pipeline model parallel "
+                "size ({})".format(parsed.pipeline_model_parallel_size),
+            )
+
+    # ---- deprecated arguments (reference :90-106)
+    _fail(
+        parsed.batch_size is None,
+        "--batch-size argument is no longer valid, use "
+        "--micro-batch-size instead",
+    )
+    del parsed.batch_size
+    _fail(
+        parsed.warmup is None,
+        "--warmup argument is no longer valid, use "
+        "--lr-warmup-fraction instead",
+    )
+    del parsed.warmup
+    _fail(
+        parsed.model_parallel_size is None,
+        "--model-parallel-size is no longer valid, use "
+        "--tensor-model-parallel-size instead",
+    )
+    del parsed.model_parallel_size
+    if parsed.checkpoint_activations:
+        parsed.activations_checkpoint_method = "uniform"
+    del parsed.checkpoint_activations
+
+    # ---- input defaults (reference :108-120): fill only unset args
     if defaults:
         for k, v in defaults.items():
             if getattr(parsed, k, None) is None:
                 setattr(parsed, k, v)
 
-    # consistency checks (reference arguments.py post-parse validation)
-    import jax
-
-    parsed.world_size = int(
-        os.environ.get("WORLD_SIZE", jax.device_count())
-    )
-    model_size = (
-        parsed.tensor_model_parallel_size * parsed.pipeline_model_parallel_size
-    )
-    if parsed.world_size % model_size != 0:
-        raise ValueError(
-            f"world size ({parsed.world_size}) is not divisible by tensor "
-            f"({parsed.tensor_model_parallel_size}) x pipeline "
-            f"({parsed.pipeline_model_parallel_size}) parallel sizes"
+    # ---- batch size (reference :122-130)
+    _fail(parsed.micro_batch_size is not None, "micro_batch_size argument is None")
+    _fail(parsed.micro_batch_size > 0, "micro batch size must be positive")
+    if parsed.global_batch_size is None:
+        parsed.global_batch_size = (
+            parsed.micro_batch_size * parsed.data_parallel_size
         )
-    parsed.data_parallel_size = parsed.world_size // model_size
-    if parsed.fp16 and parsed.bf16:
-        raise ValueError("cannot specify both fp16 and bf16")
-    if parsed.virtual_pipeline_model_parallel_size is not None:
-        if parsed.pipeline_model_parallel_size <= 2:
-            raise ValueError(
-                "pipeline-model-parallel size should be greater than 2 "
-                "with interleaved schedule"
-            )
-        if (
+    _fail(parsed.global_batch_size > 0, "global batch size must be positive")
+
+    # ---- virtual pipeline (reference :131-142)
+    if parsed.num_layers_per_virtual_pipeline_stage is not None:
+        _fail(parsed.num_layers is not None, "num_layers argument is None")
+        _fail(
+            parsed.pipeline_model_parallel_size > 2,
+            "pipeline-model-parallel size should be greater than 2 with "
+            "interleaved schedule",
+        )
+        _fail(
             parsed.num_layers
-            % (
-                parsed.virtual_pipeline_model_parallel_size
-                * parsed.pipeline_model_parallel_size
+            % parsed.num_layers_per_virtual_pipeline_stage
+            == 0,
+            "number of layers is not divisible by number of layers per "
+            "virtual pipeline stage",
+        )
+        parsed.virtual_pipeline_model_parallel_size = (
+            parsed.num_layers // parsed.pipeline_model_parallel_size
+        ) // parsed.num_layers_per_virtual_pipeline_stage
+        # beyond the reference: a per-stage chunk larger than
+        # layers-per-pipeline-stage silently derives vp == 0 there and
+        # crashes downstream; fail at parse time instead
+        _fail(
+            parsed.virtual_pipeline_model_parallel_size >= 1,
+            "number of layers is not divisible by number of model chunks",
+        )
+    else:
+        parsed.virtual_pipeline_model_parallel_size = None
+
+    # ---- parameters dtype (reference :144-162; jnp, not torch)
+    import jax.numpy as jnp
+
+    _fail(
+        not (parsed.fp16 and parsed.bf16),
+        "cannot specify both fp16 and bf16",
+    )
+    parsed.params_dtype = jnp.float32
+    if parsed.fp16:
+        parsed.params_dtype = jnp.float16
+    if parsed.bf16:
+        parsed.params_dtype = jnp.bfloat16
+        # bfloat16 requires gradient accumulation and all-reduce in fp32
+        parsed.accumulate_allreduce_grads_in_fp32 = True
+
+    # the reference's contiguous-buffer constraints are CUDA-DDP
+    # bookkeeping; the flags exist (accepted-unused) but XLA owns
+    # buffers, so no constraint web is enforced here
+    if parsed.DDP_impl == "torch":
+        parsed.use_contiguous_buffers_in_local_ddp = False
+
+    if parsed.dataloader_type is None:
+        parsed.dataloader_type = "single"
+    parsed.consumed_train_samples = 0
+    parsed.consumed_valid_samples = 0
+
+    # ---- iteration- vs sample-based training (reference :181-210)
+    if parsed.train_iters:
+        _fail(parsed.train_samples is None, "expected iteration-based training")
+        _fail(
+            parsed.lr_decay_samples is None,
+            "expected iteration-based learning rate decay",
+        )
+        _fail(
+            parsed.lr_warmup_samples == 0,
+            "expected iteration-based learning rate warmup",
+        )
+        _fail(
+            parsed.rampup_batch_size is None,
+            "expected no batch-size rampup for iteration-based training",
+        )
+        if parsed.lr_warmup_fraction is not None:
+            _fail(
+                parsed.lr_warmup_iters == 0,
+                "can only specify one of lr-warmup-fraction and "
+                "lr-warmup-iters",
             )
-            != 0
-        ):
-            raise ValueError(
-                "number of layers is not divisible by number of model chunks"
+    if parsed.train_samples:
+        _fail(parsed.train_iters is None, "expected sample-based training")
+        _fail(
+            parsed.lr_decay_iters is None,
+            "expected sample-based learning rate decay",
+        )
+        _fail(
+            parsed.lr_warmup_iters == 0,
+            "expected sample-based learnig rate warmup",
+        )
+        if parsed.lr_warmup_fraction is not None:
+            _fail(
+                parsed.lr_warmup_samples == 0,
+                "can only specify one of lr-warmup-fraction and "
+                "lr-warmup-samples",
             )
+
+    # ---- required arguments (reference :212-216)
+    for req_arg in (
+        "num_layers", "hidden_size", "num_attention_heads",
+        "max_position_embeddings",
+    ):
+        _fail(
+            getattr(parsed, req_arg) is not None,
+            "{} argument is None".format(req_arg),
+        )
+
+    # ---- derived network sizes (reference :218-224)
     if parsed.ffn_hidden_size is None:
         parsed.ffn_hidden_size = 4 * parsed.hidden_size
     if parsed.kv_channels is None:
-        assert parsed.hidden_size % parsed.num_attention_heads == 0
-        parsed.kv_channels = parsed.hidden_size // parsed.num_attention_heads
+        _fail(
+            parsed.hidden_size % parsed.num_attention_heads == 0,
+            "hidden size is not divisible by the number of attention heads",
+        )
+        parsed.kv_channels = (
+            parsed.hidden_size // parsed.num_attention_heads
+        )
+
+    # ---- sequence lengths (reference :226-236)
+    if parsed.seq_length is not None:
+        _fail(
+            parsed.encoder_seq_length is None,
+            "--seq-length is exclusive of --encoder-seq-length",
+        )
+        parsed.encoder_seq_length = parsed.seq_length
+    else:
+        _fail(
+            parsed.encoder_seq_length is not None,
+            "either --seq-length or --encoder-seq-length must be provided",
+        )
+        parsed.seq_length = parsed.encoder_seq_length
+    if parsed.seq_length is not None:
+        _fail(
+            parsed.max_position_embeddings >= parsed.seq_length,
+            "max position embeddings must cover the sequence length",
+        )
+    if parsed.decoder_seq_length is not None:
+        _fail(
+            parsed.max_position_embeddings >= parsed.decoder_seq_length,
+            "max position embeddings must cover the decoder sequence length",
+        )
+    if parsed.lr is not None:
+        _fail(parsed.min_lr <= parsed.lr, "min-lr must not exceed lr")
+    if parsed.save is not None:
+        _fail(
+            parsed.save_interval is not None,
+            "--save requires --save-interval",
+        )
+
+    # ---- mixed precision checks (reference :241-246)
+    if parsed.fp16_lm_cross_entropy:
+        _fail(
+            parsed.fp16,
+            "lm cross entropy in fp16 only support in fp16 mode.",
+        )
+    if parsed.fp32_residual_connection:
+        _fail(
+            parsed.fp16 or parsed.bf16,
+            "residual connection in fp32 only supported when using fp16 "
+            "or bf16.",
+        )
+
+    # ---- activation checkpointing (reference :247-257)
+    if parsed.distribute_checkpointed_activations:
+        _fail(
+            parsed.tensor_model_parallel_size > 1,
+            "can distribute checkpointed activations only across tensor "
+            "model parallel groups",
+        )
+        _fail(
+            parsed.activations_checkpoint_method is not None,
+            "for distribute-checkpointed-activations to work you need to "
+            "use a activation-checkpoint method ",
+        )
+        _fail(
+            parsed.num_layers_per_virtual_pipeline_stage is None,
+            "currently distributed checkpoint activations only supported "
+            "for nointerleaved pipeline parallelism",
+        )
     return parsed
 
 
-def _add_model_config_args(p):
-    g = p.add_argument_group("model")
+def _add_network_size_args(p):
+    g = p.add_argument_group("network size")
     g.add_argument("--num-layers", type=int, default=None)
     g.add_argument("--hidden-size", type=int, default=None)
     g.add_argument("--ffn-hidden-size", type=int, default=None)
     g.add_argument("--num-attention-heads", type=int, default=None)
     g.add_argument("--kv-channels", type=int, default=None)
     g.add_argument("--max-position-embeddings", type=int, default=None)
+    g.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
     g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
     g.add_argument("--apply-residual-connection-post-layernorm",
                    action="store_true")
     g.add_argument("--openai-gelu", action="store_true")
-    g.add_argument("--onnx-safe", action="store_true")
+    g.add_argument("--onnx-safe", type=bool, required=False,
+                   help="accepted for parity (no ONNX exporter here)")
+    g.add_argument("--bert-no-binary-head", action="store_false",
+                   dest="bert_binary_head")
+
+
+def _add_logging_args(p):
+    g = p.add_argument_group("logging")
+    g.add_argument("--log-params-norm", action="store_true")
+    g.add_argument("--log-num-zeros-in-grad", action="store_true")
+    g.add_argument("--tensorboard-log-interval", type=int, default=1)
+    g.add_argument("--tensorboard-queue-size", type=int, default=1000)
+    g.add_argument("--log-timers-to-tensorboard", action="store_true")
+    g.add_argument("--log-batch-size-to-tensorboard", action="store_true")
+    g.add_argument("--no-log-learnig-rate-to-tensorboard",
+                   action="store_false",
+                   dest="log_learning_rate_to_tensorboard")
+    g.add_argument("--no-log-loss-scale-to-tensorboard",
+                   action="store_false",
+                   dest="log_loss_scale_to_tensorboard")
+    g.add_argument("--log-validation-ppl-to-tensorboard",
+                   action="store_true")
+    g.add_argument("--log-memory-to-tensorboard", action="store_true")
 
 
 def _add_regularization_args(p):
@@ -121,20 +370,42 @@ def _add_regularization_args(p):
 def _add_training_args(p):
     g = p.add_argument_group("training")
     g.add_argument("--micro-batch-size", type=int, default=None)
+    g.add_argument("--batch-size", type=int, default=None,
+                   help="Old batch size parameter, do not use. "
+                   "Use --micro-batch-size instead")
     g.add_argument("--global-batch-size", type=int, default=None)
     g.add_argument("--rampup-batch-size", nargs="*", default=None)
-    g.add_argument("--checkpoint-activations", action="store_true")
+    g.add_argument("--checkpoint-activations", action="store_true",
+                   help="deprecated: migrates to "
+                   "--activations-checkpoint-method uniform")
     g.add_argument("--distribute-checkpointed-activations",
                    action="store_true")
+    g.add_argument("--activations-checkpoint-method", type=str,
+                   default=None, choices=["uniform", "block"])
+    g.add_argument("--activations-checkpoint-num-layers", type=int,
+                   default=1)
     g.add_argument("--train-iters", type=int, default=None)
     g.add_argument("--train-samples", type=int, default=None)
     g.add_argument("--log-interval", type=int, default=100)
     g.add_argument("--exit-interval", type=int, default=None)
+    g.add_argument("--exit-duration-in-mins", type=int, default=None)
     g.add_argument("--tensorboard-dir", type=str, default=None)
+    g.add_argument("--no-masked-softmax-fusion", action="store_false",
+                   dest="masked_softmax_fusion")
+    g.add_argument("--no-bias-gelu-fusion", action="store_false",
+                   dest="bias_gelu_fusion",
+                   help="accepted for parity; XLA fuses bias+gelu")
+    g.add_argument("--no-bias-dropout-fusion", action="store_false",
+                   dest="bias_dropout_fusion",
+                   help="accepted for parity; XLA fuses bias+dropout")
     g.add_argument("--optimizer", type=str, default="adam",
-                   choices=["adam", "sgd", "lamb"])
-    g.add_argument("--use-cpu-initialization", action="store_true",
-                   help="accepted for parity; initialization is functional")
+                   choices=["adam", "sgd"])
+    g.add_argument("--dataloader-type", type=str, default=None,
+                   choices=["single", "cyclic"])
+    g.add_argument("--no-async-tensor-model-parallel-allreduce",
+                   action="store_false",
+                   dest="async_tensor_model_parallel_allreduce",
+                   help="accepted for parity; XLA schedules collectives")
 
 
 def _add_initialization_args(p):
@@ -154,6 +425,9 @@ def _add_learning_rate_args(p):
     g.add_argument("--lr-warmup-fraction", type=float, default=None)
     g.add_argument("--lr-warmup-iters", type=int, default=0)
     g.add_argument("--lr-warmup-samples", type=int, default=0)
+    g.add_argument("--warmup", type=int, default=None,
+                   help="Old lr warmup argument, do not use. Use one of "
+                   "the --lr-warmup-* arguments above")
     g.add_argument("--min-lr", type=float, default=0.0)
     g.add_argument("--override-lr-scheduler", action="store_true")
     g.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
@@ -163,11 +437,11 @@ def _add_checkpointing_args(p):
     g = p.add_argument_group("checkpointing")
     g.add_argument("--save", type=str, default=None)
     g.add_argument("--save-interval", type=int, default=None)
-    g.add_argument("--no-save-optim", action="store_true")
-    g.add_argument("--no-save-rng", action="store_true")
+    g.add_argument("--no-save-optim", action="store_true", default=None)
+    g.add_argument("--no-save-rng", action="store_true", default=None)
     g.add_argument("--load", type=str, default=None)
-    g.add_argument("--no-load-optim", action="store_true")
-    g.add_argument("--no-load-rng", action="store_true")
+    g.add_argument("--no-load-optim", action="store_true", default=None)
+    g.add_argument("--no-load-rng", action="store_true", default=None)
     g.add_argument("--finetune", action="store_true")
 
 
@@ -193,7 +467,12 @@ def _add_distributed_args(p):
     g = p.add_argument_group("distributed")
     g.add_argument("--tensor-model-parallel-size", type=int, default=1)
     g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
-    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+    g.add_argument("--pipeline-model-parallel-split-rank", type=int,
+                   default=None)
+    g.add_argument("--model-parallel-size", type=int, default=None,
+                   help="Old model parallel argument, do not use. Use "
+                   "--tensor-model-parallel-size instead.")
+    g.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
                    default=None)
     g.add_argument("--distributed-backend", default="xla",
                    choices=["xla", "nccl", "gloo"],
@@ -201,11 +480,22 @@ def _add_distributed_args(p):
     g.add_argument("--DDP-impl", default="local",
                    choices=["local", "torch"],
                    help="accepted for parity")
+    g.add_argument("--no-contiguous-buffers-in-local-ddp",
+                   action="store_false",
+                   dest="use_contiguous_buffers_in_local_ddp",
+                   help="accepted for parity; XLA owns buffers")
+    g.add_argument("--no-scatter-gather-tensors-in-pipeline",
+                   action="store_false",
+                   dest="scatter_gather_tensors_in_pipeline")
     g.add_argument("--local_rank", type=int, default=None)
-    g.add_argument("--lazy-mpu-init", type=bool, default=None)
+    g.add_argument("--lazy-mpu-init", type=bool, required=False)
+    g.add_argument("--use-cpu-initialization", action="store_true",
+                   default=None,
+                   help="accepted for parity; initialization is functional")
+    g.add_argument("--empty-unused-memory-level", default=0, type=int,
+                   choices=[0, 1, 2],
+                   help="accepted for parity; no CUDA caches to empty")
     g.add_argument("--use-ring-exchange-p2p", action="store_true")
-    g.add_argument("--scatter-gather-tensors-in-pipeline",
-                   action="store_true")
 
 
 def _add_validation_args(p):
@@ -215,15 +505,61 @@ def _add_validation_args(p):
 
 
 def _add_data_args(p):
-    g = p.add_argument_group("data")
+    g = p.add_argument_group("data and dataloader")
     g.add_argument("--data-path", nargs="*", default=None)
     g.add_argument("--split", type=str, default="969, 30, 1")
     g.add_argument("--vocab-file", type=str, default=None)
     g.add_argument("--merge-file", type=str, default=None)
+    g.add_argument("--vocab-extra-ids", type=int, default=0)
     g.add_argument("--seq-length", type=int, default=None)
     g.add_argument("--encoder-seq-length", type=int, default=None)
     g.add_argument("--decoder-seq-length", type=int, default=None)
+    g.add_argument("--retriever-seq-length", type=int, default=256)
+    g.add_argument("--sample-rate", type=float, default=1.0)
+    g.add_argument("--mask-prob", type=float, default=0.15)
+    g.add_argument("--short-seq-prob", type=float, default=0.1)
+    g.add_argument("--mmap-warmup", action="store_true")
     g.add_argument("--num-workers", type=int, default=2)
+    g.add_argument("--tokenizer-type", type=str, default=None,
+                   choices=["BertWordPieceLowerCase", "BertWordPieceCase",
+                            "GPT2BPETokenizer"])
+    g.add_argument("--data-impl", type=str, default="infer",
+                   choices=["lazy", "cached", "mmap", "infer"])
     g.add_argument("--reset-position-ids", action="store_true")
     g.add_argument("--reset-attention-mask", action="store_true")
     g.add_argument("--eod-mask-loss", action="store_true")
+
+
+def _add_autoresume_args(p):
+    g = p.add_argument_group("autoresume")
+    g.add_argument("--adlr-autoresume", action="store_true")
+    g.add_argument("--adlr-autoresume-interval", type=int, default=1000)
+
+
+def _add_biencoder_args(p):
+    g = p.add_argument_group("biencoder")
+    g.add_argument("--ict-head-size", type=int, default=None)
+    g.add_argument("--biencoder-projection-dim", type=int, default=0)
+    g.add_argument("--biencoder-shared-query-context-model",
+                   action="store_true")
+    g.add_argument("--ict-load", type=str, default=None)
+    g.add_argument("--bert-load", type=str, default=None)
+    g.add_argument("--titles-data-path", type=str, default=None)
+    g.add_argument("--query-in-block-prob", type=float, default=0.1)
+    g.add_argument("--use-one-sent-docs", action="store_true")
+    g.add_argument("--evidence-data-path", type=str, default=None)
+    g.add_argument("--retriever-report-topk-accuracies", nargs="+",
+                   type=int, default=[])
+    g.add_argument("--retriever-score-scaling", action="store_true")
+    g.add_argument("--block-data-path", type=str, default=None)
+    g.add_argument("--embedding-path", type=str, default=None)
+    g.add_argument("--indexer-batch-size", type=int, default=128)
+    g.add_argument("--indexer-log-interval", type=int, default=1000)
+
+
+def _add_vit_args(p):
+    g = p.add_argument_group("vit")
+    g.add_argument("--num-classes", type=int, default=1000)
+    g.add_argument("--img-dim", type=int, default=224)
+    g.add_argument("--num-channels", type=int, default=3)
+    g.add_argument("--patch-dim", type=int, default=16)

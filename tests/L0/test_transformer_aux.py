@@ -98,39 +98,148 @@ class TestTimers:
         assert "fwd" in lines[0]
 
 
+CORE = [
+    "--num-layers", "4", "--hidden-size", "64",
+    "--num-attention-heads", "4", "--micro-batch-size", "2",
+    "--max-position-embeddings", "64", "--seq-length", "64",
+]
+
+
 class TestArguments:
     def test_parse_core_flags(self):
-        args = parse_args(args=[
-            "--num-layers", "4", "--hidden-size", "64",
-            "--num-attention-heads", "4", "--micro-batch-size", "2",
-            "--bf16",
-        ])
+        args = parse_args(args=CORE + ["--bf16"])
         assert args.ffn_hidden_size == 256  # 4 * hidden
         assert args.kv_channels == 16
         assert args.bf16 and not args.fp16
         assert args.data_parallel_size >= 1
+        # bf16 forces fp32 grad accumulation (reference arguments.py:152)
+        assert args.accumulate_allreduce_grads_in_fp32
+        assert args.encoder_seq_length == 64
+        assert args.global_batch_size == 2 * args.data_parallel_size
 
     def test_fp16_bf16_conflict(self):
         with pytest.raises(ValueError, match="both"):
-            parse_args(args=["--num-layers", "2", "--hidden-size", "8",
-                             "--num-attention-heads", "2",
-                             "--fp16", "--bf16"])
+            parse_args(args=CORE + ["--fp16", "--bf16"])
 
     def test_world_divisibility(self):
         with pytest.raises(ValueError, match="divisible"):
+            parse_args(args=CORE + ["--tensor-model-parallel-size", "3"])
+
+    def test_reference_flag_combinations(self):
+        """The reference's documented launch-script combos parse whole
+        (reference: apex/transformer/testing/arguments.py groups)."""
+        args = parse_args(args=CORE + [
+            "--bf16", "--tensor-model-parallel-size", "2",
+            "--pipeline-model-parallel-size", "2",
+            "--train-iters", "100", "--lr", "1.5e-4", "--min-lr", "1e-5",
+            "--lr-decay-style", "cosine", "--lr-warmup-fraction", "0.01",
+            "--clip-grad", "1.0", "--weight-decay", "0.01",
+            "--adam-beta1", "0.9", "--adam-beta2", "0.95",
+            "--activations-checkpoint-method", "uniform",
+            "--DDP-impl", "local", "--optimizer", "adam",
+            "--split", "949,50,1", "--eval-interval", "500",
+            "--log-interval", "10", "--save-interval", "1000",
+            "--save", "/tmp/ckpt", "--init-method-std", "0.006",
+            "--make-vocab-size-divisible-by", "128",
+            "--no-masked-softmax-fusion", "--num-workers", "2",
+        ])
+        assert args.data_parallel_size == 2  # 8 devices / (tp2 x pp2)
+        assert not args.masked_softmax_fusion
+        assert args.activations_checkpoint_method == "uniform"
+
+    def test_deprecated_args_rejected(self):
+        """The reference's deprecated-flag errors reproduce verbatim
+        (reference arguments.py:90-99)."""
+        with pytest.raises(ValueError, match="micro-batch-size instead"):
+            parse_args(args=CORE + ["--batch-size", "4"])
+        with pytest.raises(ValueError, match="lr-warmup-fraction instead"):
+            parse_args(args=CORE + ["--warmup", "100"])
+        with pytest.raises(
+            ValueError, match="tensor-model-parallel-size instead"
+        ):
+            parse_args(args=CORE + ["--model-parallel-size", "2"])
+
+    def test_checkpoint_activations_migration(self):
+        """--checkpoint-activations migrates to the uniform method and
+        the old attr is deleted (reference arguments.py:100-106)."""
+        args = parse_args(args=CORE + ["--checkpoint-activations"])
+        assert args.activations_checkpoint_method == "uniform"
+        assert not hasattr(args, "checkpoint_activations")
+
+    def test_virtual_pipeline_derivation(self):
+        """virtual size = (layers/pp) / layers-per-virtual-stage
+        (reference arguments.py:131-142), with its two validations."""
+        args = parse_args(args=[
+            "--num-layers", "8", "--hidden-size", "64",
+            "--num-attention-heads", "4", "--micro-batch-size", "2",
+            "--max-position-embeddings", "64", "--seq-length", "64",
+            "--pipeline-model-parallel-size", "4",
+            "--num-layers-per-virtual-pipeline-stage", "1",
+        ])
+        assert args.virtual_pipeline_model_parallel_size == 2
+        with pytest.raises(ValueError, match="greater than 2"):
+            parse_args(args=CORE + [
+                "--pipeline-model-parallel-size", "2",
+                "--num-layers-per-virtual-pipeline-stage", "1",
+            ])
+
+    def test_iteration_vs_sample_exclusivity(self):
+        with pytest.raises(ValueError, match="iteration-based training"):
+            parse_args(args=CORE + [
+                "--train-iters", "10", "--train-samples", "100",
+            ])
+        with pytest.raises(
+            ValueError, match="sample-based learning rate decay"
+        ):
+            parse_args(args=CORE + [
+                "--train-samples", "100", "--lr-decay-iters", "10",
+            ])
+
+    def test_required_and_seq_length_web(self):
+        with pytest.raises(ValueError, match="max_position_embeddings"):
             parse_args(args=[
                 "--num-layers", "2", "--hidden-size", "8",
-                "--num-attention-heads", "2",
-                "--tensor-model-parallel-size", "3",
+                "--num-attention-heads", "2", "--micro-batch-size", "1",
+                "--seq-length", "8",
             ])
+        with pytest.raises(ValueError, match="cover the sequence length"):
+            parse_args(args=[
+                "--num-layers", "2", "--hidden-size", "8",
+                "--num-attention-heads", "2", "--micro-batch-size", "1",
+                "--max-position-embeddings", "8", "--seq-length", "16",
+            ])
+        with pytest.raises(ValueError, match="exclusive"):
+            parse_args(args=CORE + ["--encoder-seq-length", "32"])
+
+    def test_mixed_precision_web(self):
+        with pytest.raises(ValueError, match="fp16 mode"):
+            parse_args(args=CORE + ["--fp16-lm-cross-entropy"])
+        with pytest.raises(ValueError, match="fp16 or bf16"):
+            parse_args(args=CORE + ["--fp32-residual-connection"])
+        with pytest.raises(ValueError, match="save-interval"):
+            parse_args(args=CORE + ["--save", "/tmp/x"])
+
+    def test_accepted_unused_cuda_knobs(self):
+        """CUDA-only knobs parse (accepted-unused) so downstream launch
+        scripts run unchanged."""
+        args = parse_args(args=CORE + [
+            "--distributed-backend", "nccl",
+            "--no-contiguous-buffers-in-local-ddp",
+            "--empty-unused-memory-level", "2",
+            "--no-bias-gelu-fusion", "--no-bias-dropout-fusion",
+            "--no-async-tensor-model-parallel-allreduce",
+            "--tokenizer-type", "GPT2BPETokenizer",
+            "--data-impl", "mmap", "--adlr-autoresume",
+            "--img-dim", "224", "--patch-dim", "16",
+            "--biencoder-projection-dim", "128",
+        ])
+        assert args.empty_unused_memory_level == 2
+        assert not args.bias_gelu_fusion
 
     def test_global_vars_singleton(self):
         global_vars._destroy_global_vars()
-        global_vars.set_global_variables(args=[
-            "--num-layers", "2", "--hidden-size", "8",
-            "--num-attention-heads", "2",
-        ])
-        assert global_vars.get_args().num_layers == 2
+        global_vars.set_global_variables(args=CORE)
+        assert global_vars.get_args().num_layers == 4
         assert global_vars.get_timers() is not None
         with pytest.raises(AssertionError, match="already"):
             global_vars.set_global_variables(args=[])
