@@ -71,18 +71,152 @@ class trace:
 
 
 class OpStat(
-    collections.namedtuple("OpStat", ["name", "total_ms", "count", "category"])
+    collections.namedtuple(
+        "OpStat",
+        [
+            "name", "total_ms", "count", "category",
+            # pyprof-style accounting (estimates from HLO shapes):
+            "flops",        # total FLOPs attributed to this op row
+            "bytes",        # total HBM bytes moved (operands + outputs)
+            "tflops_sec",   # achieved TFLOP/s over the row's device time
+            "gb_sec",       # achieved GB/s over the row's device time
+            "pct_peak",     # roofline % of peak: max(flops-, bytes-bound)
+        ],
+    )
 ):
     __slots__ = ()
 
 
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 0.5, "u4": 0.5, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+# best-effort per-chip peaks for the roofline column (bf16 FLOPs, HBM)
+_CHIP_PEAKS = {
+    "v6": (918e12, 1640e9),
+    "v5p": (459e12, 2765e9),
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+}
+
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([\d,]*)\]")
+
+
+def _dtype_bytes(dt: str):
+    if dt.startswith("f8"):
+        return 1
+    return _DTYPE_BYTES.get(dt)
+
+
+def _parse_shapes(long_name: str):
+    """[(dtype_bytes, element_count, dims), ...] — first entry is the
+    output. HLO text lists the result first, then operands:
+    ``%fusion.1 = bf16[16384,1024]{...} fusion(bf16[...] %a, ...)``.
+    Tuple results contribute one entry per element.
+    """
+    out = []
+    for dt, dims_s in _SHAPE_RE.findall(long_name):
+        size = _dtype_bytes(dt)
+        if size is None:
+            continue
+        dims = tuple(int(d) for d in dims_s.split(",") if d)
+        n = 1
+        for d in dims:
+            n *= d
+        out.append((size, n, dims))
+    return out
+
+
+def _matmul_flops(out_dims, a_dims, b_dims, out_n):
+    """2·|C|·k when (a, b) → out looks like a contraction.
+
+    Transpose-agnostic dim-multiset test: for C = A·B the dims of A
+    and B combined, minus C's dims, leave the contraction dim twice
+    (plus batch dims once each, which C also carries). An elementwise
+    pair (A, B same shape as C) leaves a full copy of C's dims
+    instead, so it fails the exactly-one-dim-with-count>=2 test unless
+    it genuinely is matmul-shaped.
+    """
+    rem = collections.Counter(a_dims) + collections.Counter(b_dims)
+    rem.subtract(collections.Counter(out_dims))
+    doubles = [d for d, c in rem.items() if c >= 2 and d > 1]
+    if len(doubles) != 1:
+        return None
+    if any(c < 0 for c in rem.values()):
+        return None
+    return 2.0 * out_n * doubles[0]
+
+
+def _event_accounting(category: str, long_name: str):
+    """(flops, bytes) estimate for one device op.
+
+    The pyprof analogue (reference: apex/pyprof/prof/blas.py, conv.py —
+    per-op-class formulas from shapes). Bytes = sum of operand + result
+    buffer sizes. FLOPs: fusions whose category says they carry a dot/
+    conv ("convolution fusion", kOutput "custom fusion") get the
+    contraction recovered by `_matmul_flops` over the two largest
+    operands; everything elementwise/reduce counts one FLOP per output
+    element; custom-calls (Pallas kernels) and copies claim bytes only.
+    """
+    shapes = _parse_shapes(long_name)
+    if not shapes:
+        return 0.0, 0.0
+    nbytes = float(sum(s * n for s, n, _ in shapes))
+    out_n = shapes[0][1]
+    cat = (category or "").lower()
+    if "custom-call" in cat:
+        # Pallas kernels: operand shapes say nothing about internal
+        # math — report the (real) HBM traffic, no FLOP claim
+        return 0.0, nbytes
+    if "convolution" in cat or cat == "custom fusion":
+        ops = sorted(shapes[1:], key=lambda t: -t[1])
+        if len(ops) >= 2 and out_n:
+            f = _matmul_flops(
+                shapes[0][2], ops[0][2], ops[1][2], out_n
+            )
+            if f is not None:
+                return f, nbytes
+        return float(out_n), nbytes
+    if "copy" in cat or "data formatting" in cat:
+        return 0.0, nbytes
+    return float(out_n), nbytes
+
+
+_probed_kind = None
+
+
+def _probe_device_kind() -> str:
+    """Device kind for the roofline peaks, probed at most once (a live
+    jax.devices() call initializes the backend — not something a pure
+    trace-analysis function should do more than once, and callers can
+    bypass it entirely via op_stats(device_kind=...))."""
+    global _probed_kind
+    if _probed_kind is None:
+        try:
+            _probed_kind = getattr(
+                jax.devices()[0], "device_kind", ""
+            ).lower()
+        except Exception:  # no live backend: default peaks apply
+            _probed_kind = ""
+    return _probed_kind
+
+
 def op_stats(
-    log_dir: str, top: int = 0, merge_numeric_suffix: bool = True
+    log_dir: str,
+    top: int = 0,
+    merge_numeric_suffix: bool = True,
+    device_kind: Optional[str] = None,
 ) -> List[OpStat]:
-    """Aggregate per-op device time from the newest capture in
-    `log_dir` (reads the trace.json.gz XLA-op timeline; the pyprof
-    parse/prof analogue). `merge_numeric_suffix` folds fusion.12 /
-    fusion.34 into one row."""
+    """Aggregate per-op device time + FLOP/byte/roofline accounting
+    from the newest capture in `log_dir` (reads the trace.json.gz
+    XLA-op timeline; the pyprof parse/prof analogue).
+    `merge_numeric_suffix` folds fusion.12 / fusion.34 into one row;
+    `device_kind` overrides the peak table row (e.g. "tpu v5e") for
+    offline analysis."""
     files = sorted(
         glob.glob(f"{log_dir}/plugins/profile/*/*.trace.json.gz")
     )
@@ -105,8 +239,18 @@ def op_stats(
         p for (p, t), n in tids.items() if n == "XLA Ops"
     } | {p for p, n in names.items() if "TPU" in n or "GPU" in n}
 
+    if device_kind is None:
+        device_kind = _probe_device_kind()
+    peak_f, peak_b = 1e12, 100e9
+    for key, (pf, pb) in _CHIP_PEAKS.items():
+        if key in device_kind:
+            peak_f, peak_b = pf, pb
+            break
+
     tot = collections.Counter()
     cnt = collections.Counter()
+    flops = collections.Counter()
+    nbytes = collections.Counter()
     cat = {}
     for e in data.get("traceEvents", []):
         if (
@@ -118,15 +262,33 @@ def op_stats(
             base = e["name"]
             if merge_numeric_suffix:
                 base = re.sub(r"[.\d]+$", "", base)
+            args = e.get("args") or {}
             tot[base] += e["dur"]
             cnt[base] += 1
-            cat.setdefault(
-                base, (e.get("args") or {}).get("hlo_category", "")
+            cat.setdefault(base, args.get("hlo_category", ""))
+            # account with THIS event's category: merged rows can mix
+            # categories (fusion.1 loop fusion, fusion.2 conv fusion)
+            f, b = _event_accounting(
+                args.get("hlo_category", "") or base,
+                args.get("long_name", ""),
             )
+            flops[base] += f
+            nbytes[base] += b
 
-    stats = [
-        OpStat(n, tot[n] / 1e3, cnt[n], cat.get(n, ""))
-        for n in tot
-    ]
+    def row(n):
+        ms = tot[n] / 1e3
+        sec = ms / 1e3
+        tf = flops[n] / sec / 1e12 if sec else 0.0
+        gb = nbytes[n] / sec / 1e9 if sec else 0.0
+        pct = max(
+            flops[n] / sec / peak_f if sec else 0.0,
+            nbytes[n] / sec / peak_b if sec else 0.0,
+        ) * 100.0
+        return OpStat(
+            n, ms, cnt[n], cat.get(n, ""),
+            flops[n], nbytes[n], round(tf, 3), round(gb, 2), round(pct, 2),
+        )
+
+    stats = [row(n) for n in tot]
     stats.sort(key=lambda s: -s.total_ms)
     return stats[:top] if top else stats
