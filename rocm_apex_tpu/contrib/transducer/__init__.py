@@ -10,6 +10,7 @@ from rocm_apex_tpu.contrib.transducer.transducer import (  # noqa: F401
     TransducerLoss,
     transducer_joint,
     transducer_loss,
+    transducer_loss_packed,
 )
 
 __all__ = [
@@ -17,4 +18,5 @@ __all__ = [
     "TransducerLoss",
     "transducer_joint",
     "transducer_loss",
+    "transducer_loss_packed",
 ]

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 __all__ = [
     "transducer_joint",
     "transducer_loss",
+    "transducer_loss_packed",
     "TransducerJoint",
     "TransducerLoss",
 ]
@@ -188,8 +189,50 @@ class TransducerJoint:
         )
 
 
+def transducer_loss_packed(
+    x: jnp.ndarray,
+    label: jnp.ndarray,
+    f_len: jnp.ndarray,
+    y_len: jnp.ndarray,
+    blank_idx: int,
+    batch_offset: jnp.ndarray,
+    max_f_len: int,
+) -> jnp.ndarray:
+    """`transducer_loss` on packed ``x (total, V)`` input.
+
+    The packed layout is the joint's `pack_output=True` form: batch b's
+    rows occupy ``[batch_offset[b-1], batch_offset[b])`` with row
+    ``t*(y_len[b]+1) + u`` inside the span, where
+    ``batch_offset = cumsum(f_len*(y_len+1))`` and ``max_f_len`` is the
+    static T bound (reference transducer.py:89-117 packed_input args).
+
+    TPU-native strategy: one gather restores the padded (B, T, U, V)
+    layout — the recurrence then runs on the dense fast path, and the
+    gather's transpose scatters cotangents back so don't-care rows get
+    exactly zero gradient (matching the reference's packed backward).
+    Padded cells gather row 0 and are masked/ignored by the loss (u
+    beyond y_len is forced to -inf, t beyond f_len never reaches the
+    final alpha read).
+    """
+    B = label.shape[0]
+    U = label.shape[1] + 1
+    T = int(max_f_len)
+    g_len = y_len + 1
+    start = jnp.concatenate(
+        [jnp.zeros((1,), batch_offset.dtype), batch_offset[:-1]]
+    )
+    t_ids = jnp.arange(T)[None, :, None]
+    u_ids = jnp.arange(U)[None, None, :]
+    rows = start[:, None, None] + t_ids * g_len[:, None, None] + u_ids
+    valid = (t_ids < f_len[:, None, None]) & (u_ids < g_len[:, None, None])
+    rows = jnp.where(valid, rows, 0)
+    x_pad = x[rows]  # (B, T, U, V)
+    return transducer_loss(x_pad, label, f_len, y_len, blank_idx)
+
+
 class TransducerLoss:
-    """Module facade (reference transducer.py:69-117)."""
+    """Module facade (reference transducer.py:69-117), including the
+    packed-input mode (batch_offset + max_f_len, reference :89-117)."""
 
     def __init__(
         self,
@@ -198,12 +241,20 @@ class TransducerLoss:
         packed_input: bool = False,
     ):
         del fuse_softmax_backward, opt
-        if packed_input:
-            raise NotImplementedError(
-                "packed_input: unpack with transducer_joint(pack_output="
-                "False) on TPU — XLA's fusion makes the padded layout the "
-                "fast path"
-            )
+        self.packed_input = packed_input
 
-    def __call__(self, x, label, f_len, y_len, blank_idx):
+    def __call__(
+        self, x, label, f_len, y_len, blank_idx,
+        batch_offset=None, max_f_len=None,
+    ):
+        if self.packed_input:
+            if batch_offset is None or max_f_len is None:
+                raise ValueError(
+                    "Please specify batch_offset and max_f_len when "
+                    "packing is enabled"
+                )
+            return transducer_loss_packed(
+                x, label, f_len, y_len, blank_idx, batch_offset,
+                max_f_len,
+            )
         return transducer_loss(x, label, f_len, y_len, blank_idx)

@@ -112,14 +112,37 @@ def _dtype_bytes(dt: str):
     return _DTYPE_BYTES.get(dt)
 
 
-def _parse_shapes(long_name: str):
-    """[(dtype_bytes, element_count, dims), ...] — first entry is the
-    output. HLO text lists the result first, then operands:
-    ``%fusion.1 = bf16[16384,1024]{...} fusion(bf16[...] %a, ...)``.
-    Tuple results contribute one entry per element.
+def _split_result(long_name: str):
+    """(result_text, rest_text) for an HLO line.
+
+    ``%f = bf16[...]{...} fusion(...)`` → result token before the
+    opcode; tuple results ``= (t1, t2) fusion(...)`` need a balanced
+    paren scan because layouts contain parens (``{1,0:T(8,128)}``).
     """
+    eq = long_name.find("= ")
+    if eq < 0:
+        return "", long_name
+    body = long_name[eq + 2 :]
+    if body.startswith("("):
+        depth = 0
+        for i, ch in enumerate(body):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    return body[: i + 1], body[i + 1 :]
+        return body, ""
+    sp = body.find(" ")
+    if sp < 0:
+        return body, ""
+    return body[:sp], body[sp:]
+
+
+def _parse_shapes(text: str):
+    """[(dtype_bytes, element_count, dims), ...] for one HLO fragment."""
     out = []
-    for dt, dims_s in _SHAPE_RE.findall(long_name):
+    for dt, dims_s in _SHAPE_RE.findall(text):
         size = _dtype_bytes(dt)
         if size is None:
             continue
@@ -136,10 +159,12 @@ def _matmul_flops(out_dims, a_dims, b_dims, out_n):
 
     Transpose-agnostic dim-multiset test: for C = A·B the dims of A
     and B combined, minus C's dims, leave the contraction dim twice
-    (plus batch dims once each, which C also carries). An elementwise
-    pair (A, B same shape as C) leaves a full copy of C's dims
-    instead, so it fails the exactly-one-dim-with-count>=2 test unless
-    it genuinely is matmul-shaped.
+    (plus batch dims once each, which C also carries). Most
+    elementwise pairs fail the exactly-one-dim-left-twice test; a
+    SQUARE same-shape pair ([N,N], [N,N] → [N,N]) is genuinely
+    ambiguous from shapes alone and is counted as a matmul — callers
+    only take this path for fusion categories XLA says carry a
+    dot/conv, which is the right prior for that ambiguity.
     """
     rem = collections.Counter(a_dims) + collections.Counter(b_dims)
     rem.subtract(collections.Counter(out_dims))
@@ -162,22 +187,30 @@ def _event_accounting(category: str, long_name: str):
     operands; everything elementwise/reduce counts one FLOP per output
     element; custom-calls (Pallas kernels) and copies claim bytes only.
     """
-    shapes = _parse_shapes(long_name)
-    if not shapes:
+    res_text, ops_text = _split_result(long_name)
+    results = _parse_shapes(res_text)
+    operands = _parse_shapes(ops_text)
+    if not results and not operands:
         return 0.0, 0.0
-    nbytes = float(sum(s * n for s, n, _ in shapes))
-    out_n = shapes[0][1]
+    nbytes = float(
+        sum(s * n for s, n, _ in results)
+        + sum(s * n for s, n, _ in operands)
+    )
+    # the LARGEST result element is the op's real output; a tuple's
+    # small extras (fused probe scalars etc.) are epilogues
+    out = max(results, key=lambda t: t[1]) if results else None
+    out_n = out[1] if out else 0
     cat = (category or "").lower()
     if "custom-call" in cat:
         # Pallas kernels: operand shapes say nothing about internal
         # math — report the (real) HBM traffic, no FLOP claim
         return 0.0, nbytes
     if "convolution" in cat or cat == "custom fusion":
-        ops = sorted(shapes[1:], key=lambda t: -t[1])
-        if len(ops) >= 2 and out_n:
-            f = _matmul_flops(
-                shapes[0][2], ops[0][2], ops[1][2], out_n
-            )
+        # tuple-result elements are NOT candidate matmul operands —
+        # only the true operand list qualifies
+        ops = sorted(operands, key=lambda t: -t[1])
+        if len(ops) >= 2 and out is not None and out_n:
+            f = _matmul_flops(out[2], ops[0][2], ops[1][2], out_n)
             if f is not None:
                 return f, nbytes
         return float(out_n), nbytes

@@ -193,6 +193,66 @@ class TestTransducer:
                        jnp.asarray([1]), 0)
         assert out.shape == (1,)
 
+    def test_loss_packed_matches_padded(self):
+        """packed_input mode (reference transducer.py:89-117):
+        batch_offset = cumsum(f_len*(y_len+1)), max_f_len = T. The
+        loss and the per-row gradients must match the padded path,
+        with zero grads on don't-care rows never packed."""
+        B, T, U, V = 3, 5, 4, 7
+        x = jax.random.normal(jax.random.PRNGKey(17), (B, T, U, V))
+        label = jax.random.randint(jax.random.PRNGKey(18), (B, U - 1), 1, V)
+        f_len = jnp.asarray([5, 3, 4])
+        y_len = jnp.asarray([3, 1, 2])
+        g_len = y_len + 1
+        batch_offset = jnp.cumsum(f_len * g_len)
+        total = int(batch_offset[-1])
+
+        # pack the VALID region of x row-major (t-major, u-minor)
+        def pack(x):
+            rows = []
+            for b in range(B):
+                for t in range(int(f_len[b])):
+                    for u in range(int(g_len[b])):
+                        rows.append(x[b, t, u])
+            return jnp.stack(rows)
+
+        xp = pack(x)
+        assert xp.shape == (total, V)
+
+        loss_mod = TransducerLoss(packed_input=True)
+        got = loss_mod(
+            xp, label, f_len, y_len, 0,
+            batch_offset=batch_offset, max_f_len=T,
+        )
+        want = transducer_loss(x, label, f_len, y_len, 0)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+        g_packed = jax.grad(
+            lambda xp: loss_mod(
+                xp, label, f_len, y_len, 0,
+                batch_offset=batch_offset, max_f_len=T,
+            ).sum()
+        )(xp)
+        g_padded = jax.grad(
+            lambda x: transducer_loss(x, label, f_len, y_len, 0).sum()
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(g_packed),
+            np.asarray(pack(g_padded)),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+
+    def test_loss_packed_requires_offsets(self):
+        loss_mod = TransducerLoss(packed_input=True)
+        with pytest.raises(ValueError, match="batch_offset"):
+            loss_mod(
+                jnp.zeros((4, 5)), jnp.ones((1, 1), jnp.int32),
+                jnp.asarray([2]), jnp.asarray([1]), 0,
+            )
+
 
 class TestASP:
     def test_mask_keeps_top2_of_4(self):

@@ -85,6 +85,20 @@ class TestEventAccounting:
         flops, _ = _event_accounting("convolution fusion", ln)
         assert flops == 16384 * 1024
 
+    def test_tuple_result_not_an_operand(self):
+        """A tuple-result fusion (e.g. update+probe) must not feed its
+        second RESULT element into the matmul-operand pair (round-2
+        review): the contraction comes from the true operands."""
+        ln = (
+            "%f = (f32[]{:T(128)}, bf16[128,256]{1,0}) fusion("
+            "bf16[128,512]{1,0} %a, bf16[512,256]{1,0} %b)"
+        )
+        flops, nbytes = _event_accounting("custom fusion", ln)
+        # the LARGEST result element is the real output (the scalar is
+        # a fused-probe epilogue): the contraction is still recovered
+        assert nbytes == 4 + 2 * (128 * 256 + 128 * 512 + 512 * 256)
+        assert flops == 2 * 128 * 256 * 512
+
     def test_batched_matmul(self):
         # C[b,m,n] = A[b,m,k] @ B[b,k,n]
         ln = (
