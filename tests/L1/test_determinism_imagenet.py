@@ -1,0 +1,153 @@
+"""L1 determinism at model scale: the imagenet example's ResNet path.
+
+The reference's L1 harness drives the REAL RN50 example across
+{opt-level × loss-scale × keep-BN-fp32} and compares full loss traces
+(reference: tests/L1/common/run_test.sh:20-27 runs main_amp.py,
+compare.py:34-50 asserts bitwise-equal per-config traces and inspects
+cross-config drift). This file is that harness against the TPU build's
+example step (examples/imagenet_train.py local_step, minus the mesh):
+a ResNet-18 with live BatchNorm batch_stats — the part the toy-Dense
+cross-product (test_determinism_cross_product.py) cannot exercise,
+since BN is exactly what `keep_batchnorm_fp32` exists for.
+
+Tolerance tiers:
+  * same config, two runs             -> bitwise equal over ALL steps
+    (the reference's actual compare.py bar: it diffs two runs of the
+    SAME config between builds, never across precision configs)
+  * O1/O2/O4/O5 static-scale vs O0    -> rtol/atol 5e-2 over the first
+    3 steps (a ResNet+BN trajectory on a tiny batch is chaotic; later
+    steps diverge for legitimate rounding reasons)
+  * dynamic-scale configs             -> finite (the fp16 levels start
+    at scale 2^16 and legitimately skip early steps, shifting the
+    trajectory relative to O0 — the reference accepts this too)
+  * O3 (pure low precision)           -> finite
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rocm_apex_tpu import amp, models
+from rocm_apex_tpu.optimizers import FusedSGD
+
+STEPS = 6
+BATCH = 8
+SIZE = 32
+CLASSES = 10
+
+
+def run_training(opt_level, loss_scale=None, keep_bn=None, seed=0):
+    """One config of the example's training step; returns the loss
+    trace (the compare.py artifact)."""
+    model = models.resnet18(num_classes=CLASSES)
+    x = jax.random.normal(
+        jax.random.PRNGKey(seed), (BATCH, SIZE, SIZE, 3), jnp.float32
+    )
+    y = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (BATCH,), 0, CLASSES
+    )
+    variables = model.init(jax.random.PRNGKey(seed + 2), x)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    overrides = {}
+    if loss_scale is not None:
+        overrides["loss_scale"] = loss_scale
+    if keep_bn is not None:
+        overrides["keep_batchnorm_fp32"] = keep_bn
+    optimizer = FusedSGD(0.01, momentum=0.9, weight_decay=1e-4)
+    params, optimizer, st = amp.initialize(
+        params, optimizer, opt_level=opt_level, verbosity=0, **overrides
+    )
+    opt_state = optimizer.init(params)
+    sstates = st.scaler_states
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, sstates, x, y):
+        state = st.replace(scaler_states=sstates)
+
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                x,
+                mutable=["batch_stats"],
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y
+            ).mean()
+            return amp.scale_loss(ce, state), (mut["batch_stats"], ce)
+
+        (_, (bs2, ce)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        grads, found_inf = amp.unscale_grads(grads, state)
+        state2, skip = amp.update_scale(state, found_inf)
+        updates, opt2 = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params = amp.skip_step(skip, new_params, params)
+        opt2 = amp.skip_step(skip, opt2, opt_state)
+        return new_params, bs2, opt2, state2.scaler_states, ce
+
+    trace = []
+    for _ in range(STEPS):
+        params, batch_stats, opt_state, sstates, ce = step(
+            params, batch_stats, opt_state, sstates, x, y
+        )
+        trace.append(float(ce))
+    return np.asarray(trace)
+
+
+@pytest.fixture(scope="module")
+def baseline_trace():
+    return run_training("O0")
+
+
+class TestImagenetDeterminism:
+    @pytest.mark.parametrize("opt_level", ["O0", "O2", "O5"])
+    def test_same_config_bitwise(self, opt_level):
+        """compare.py:34-50's bar within one build: identical runs of
+        the real model produce bitwise-identical loss traces."""
+        a = run_training(opt_level)
+        b = run_training(opt_level)
+        np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.parametrize(
+        "opt_level,loss_scale",
+        [
+            ("O1", 128.0),
+            ("O2", 128.0),
+            ("O4", None),
+            ("O5", None),
+        ],
+    )
+    def test_close_to_fp32(self, baseline_trace, opt_level, loss_scale):
+        """Static-scale (no skip-step) mixed-precision configs track
+        the fp32 trajectory over the early steps."""
+        trace = run_training(opt_level, loss_scale)
+        assert np.isfinite(trace).all(), (opt_level, loss_scale, trace)
+        np.testing.assert_allclose(
+            trace[:3], baseline_trace[:3], rtol=5e-2, atol=5e-2,
+            err_msg=f"{opt_level} scale={loss_scale}",
+        )
+
+    @pytest.mark.parametrize(
+        "opt_level,loss_scale",
+        [("O2", "dynamic"), ("O5", "dynamic"), ("O3", "dynamic")],
+    )
+    def test_dynamic_scale_trains(self, opt_level, loss_scale):
+        """Dynamic scaling starts at 2^16 and may skip early steps
+        (trajectory shift, not an error): finite is the bar."""
+        trace = run_training(opt_level, loss_scale)
+        assert np.isfinite(trace).all(), (opt_level, trace)
+
+    @pytest.mark.parametrize("keep_bn", [True, False])
+    def test_keep_batchnorm_fp32(self, baseline_trace, keep_bn):
+        """The keep-BN-fp32 leg of the reference cross-product: BN in
+        fp32 vs compute dtype under O2 both stay in the O0 tier."""
+        trace = run_training("O2", 128.0, keep_bn=keep_bn)
+        assert np.isfinite(trace).all()
+        np.testing.assert_allclose(
+            trace[:3], baseline_trace[:3], rtol=5e-2, atol=5e-2,
+            err_msg=f"keep_bn={keep_bn}",
+        )
