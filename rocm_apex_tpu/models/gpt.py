@@ -408,14 +408,19 @@ class ParallelTransformerLayer(nn.Module):
             attn = _Dropout(cfg.hidden_dropout, cfg.context_parallel_axis)(
                 attn, deterministic=deterministic
             )
-        residual = ln1 if cfg.apply_residual_connection_post_layernorm else x
-        x = residual + attn.astype(residual.dtype)
-
-        ln2 = MixedFusedLayerNorm(
+        ln2_mod = MixedFusedLayerNorm(
             cfg.hidden_size,
             eps=cfg.layernorm_epsilon,
             name="post_attention_layernorm",
-        )(x)
+        )
+        if cfg.apply_residual_connection_post_layernorm:
+            residual = ln1
+            x = residual + attn.astype(residual.dtype)
+            ln2 = ln2_mod(x)
+        else:
+            # pre-LN: the residual add fuses into the LN kernel (the
+            # standalone add is a pure HBM round trip otherwise)
+            ln2, x = ln2_mod(attn.astype(x.dtype), residual=x)
         mlp = ParallelMLP(cfg, name="mlp")(ln2, deterministic)
         if cfg.hidden_dropout > 0.0:
             mlp = _Dropout(cfg.hidden_dropout, cfg.context_parallel_axis)(

@@ -27,6 +27,7 @@ __all__ = [
     "fused_layer_norm",
     "fused_layer_norm_affine",
     "mixed_dtype_fused_layer_norm_affine",
+    "mixed_dtype_fused_layer_norm_residual_affine",
     "FusedLayerNorm",
     "MixedFusedLayerNorm",
 ]
@@ -111,17 +112,44 @@ class FusedLayerNorm(nn.Module):
         return fused_layer_norm(x, shape, self.eps)
 
 
+def mixed_dtype_fused_layer_norm_residual_affine(
+    x, delta, weight, bias, normalized_shape: Shape, eps: float = 1e-5
+):
+    """(LN(x+delta), x+delta) fused in one kernel; LN output follows
+    the weight dtype (the mixed contract), the stream follows x."""
+    if x.shape != delta.shape:
+        raise ValueError(
+            f"residual/delta shapes differ: {x.shape} vs {delta.shape}"
+        )
+    x2d, orig = _to_2d(x, normalized_shape)
+    d2d, _ = _to_2d(delta, normalized_shape)
+    y, s = _ln_ops.layer_norm_residual_affine(
+        x2d,
+        d2d,
+        weight.reshape(-1),
+        bias.reshape(-1),
+        eps,
+        weight.dtype,
+    )
+    return y.reshape(orig), s.reshape(orig)
+
+
 class MixedFusedLayerNorm(nn.Module):
     """flax module mirroring `MixedFusedLayerNorm`: always affine, output
     dtype follows the (fp32) params even for bf16/fp16 inputs
-    (reference: apex/normalization/fused_layer_norm.py:199-218)."""
+    (reference: apex/normalization/fused_layer_norm.py:199-218).
+
+    ``residual``: when given, the residual add fuses into the kernel —
+    the call returns ``(LN(residual + x), residual + x)`` so the new
+    stream never costs a standalone HBM pass (no reference analogue;
+    the CUDA build leaves the add to torch)."""
 
     normalized_shape: Shape
     eps: float = 1e-5
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, residual=None):
         shape = _normalize_shape(self.normalized_shape)
         weight = self.param(
             "weight", nn.initializers.ones_init(), shape, self.param_dtype
@@ -129,4 +157,8 @@ class MixedFusedLayerNorm(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros_init(), shape, self.param_dtype
         )
+        if residual is not None:
+            return mixed_dtype_fused_layer_norm_residual_affine(
+                residual, x, weight, bias, shape, self.eps
+            )
         return mixed_dtype_fused_layer_norm_affine(x, weight, bias, shape, self.eps)

@@ -13,6 +13,13 @@ Gamma/beta gradients use the reference's two-stage scheme (per-block
 partials in-kernel, final reduction outside —
 layer_norm_cuda_kernel.cu's gamma/beta two-stage reduction).
 
+One kernel pair serves both the plain and the fused-residual form
+(``residual``/``ds`` flags): `layer_norm_residual_affine` computes
+s = x + delta in-kernel, emits (LN(s), s), and folds the stream
+cotangent ds into the dx pass — the transformer's residual adds are
+otherwise standalone HBM round trips XLA cannot fuse into a custom
+call. (No reference analogue; the CUDA build leaves the add to torch.)
+
 All math is fp32 in-register; output dtype follows the input (or the
 weight dtype for the mixed variant, handled by the module layer).
 """
@@ -23,11 +30,15 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from rocm_apex_tpu.ops._pallas import kernel_dtype, pad_rows, pallas_call, row_block
 
-__all__ = ["layer_norm_fwd", "layer_norm", "layer_norm_affine"]
+__all__ = [
+    "layer_norm_fwd",
+    "layer_norm",
+    "layer_norm_affine",
+    "layer_norm_residual_affine",
+]
 
 
 def _block_rows(hidden: int) -> int:
@@ -44,12 +55,18 @@ def _pad_rows(x, block: int):
 # ---------------------------------------------------------------------------
 
 
-def _ln_fwd_kernel(affine, eps, x_ref, *refs):
+def _ln_fwd_kernel(affine, residual, eps, x_ref, *refs):
+    refs = list(refs)
+    r_ref = refs.pop(0) if residual else None
     if affine:
-        g_ref, b_ref, y_ref, mu_ref, rs_ref = refs
-    else:
-        y_ref, mu_ref, rs_ref = refs
+        g_ref, b_ref = refs.pop(0), refs.pop(0)
+    y_ref = refs.pop(0)
+    s_ref = refs.pop(0) if residual else None
+    mu_ref, rs_ref = refs
     x = x_ref[...].astype(jnp.float32)
+    if residual:
+        x = x + r_ref[...].astype(jnp.float32)
+        s_ref[...] = x.astype(s_ref.dtype)
     mu = jnp.mean(x, axis=1, keepdims=True)
     xc = x - mu
     var = jnp.mean(xc * xc, axis=1, keepdims=True)
@@ -60,6 +77,69 @@ def _ln_fwd_kernel(affine, eps, x_ref, *refs):
     y_ref[...] = y.astype(y_ref.dtype)
     mu_ref[...] = mu
     rs_ref[...] = rs
+
+
+def _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype):
+    """Shared forward: plain LN when delta2d is None, fused residual
+    form otherwise (extra s = x + delta output)."""
+    rows0, hidden = x2d.shape
+    out_dtype = out_dtype or x2d.dtype
+    affine = weight is not None
+    residual = delta2d is not None
+    block = _block_rows(hidden)
+    x_p, _ = _pad_rows(x2d, block)
+    rows = x_p.shape[0]
+    grid = rows // block
+
+    row_spec = pl.BlockSpec((block, hidden), lambda i: (i, 0))
+    col_spec = pl.BlockSpec((block, 1), lambda i: (i, 0))
+    gb_spec = pl.BlockSpec((1, hidden), lambda i: (0, 0))
+
+    ins = [x_p.astype(kernel_dtype(x_p.dtype))]
+    in_specs = [row_spec]
+    if residual:
+        r_p, _ = _pad_rows(delta2d, block)
+        ins.append(r_p.astype(kernel_dtype(r_p.dtype)))
+        in_specs.append(row_spec)
+    if affine:
+        ins += [
+            weight.reshape(1, hidden).astype(kernel_dtype(weight.dtype)),
+            bias.reshape(1, hidden).astype(kernel_dtype(bias.dtype)),
+        ]
+        in_specs += [gb_spec, gb_spec]
+
+    out_specs = [row_spec]
+    out_shape = [jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(out_dtype))]
+    if residual:
+        out_specs.append(row_spec)
+        out_shape.append(
+            jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(x2d.dtype))
+        )
+    out_specs += [col_spec, col_spec]
+    out_shape += [
+        jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+    ]
+
+    outs = pallas_call(
+        functools.partial(_ln_fwd_kernel, affine, residual, eps),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+    )(*ins)
+    if residual:
+        y, s, mu, rs = outs
+        s = s[:rows0].astype(x2d.dtype)
+    else:
+        y, mu, rs = outs
+        s = None
+    return (
+        y[:rows0].astype(out_dtype),
+        s,
+        mu[:rows0, 0],
+        rs[:rows0, 0],
+    )
 
 
 def layer_norm_fwd(
@@ -76,45 +156,8 @@ def layer_norm_fwd(
     layer reshapes arbitrary normalized_shape to this view
     (reference: apex/normalization/fused_layer_norm.py).
     """
-    rows0, hidden = x2d.shape
-    out_dtype = out_dtype or x2d.dtype
-    affine = weight is not None
-    block = _block_rows(hidden)
-    x2d, rows0 = _pad_rows(x2d, block)
-    rows = x2d.shape[0]
-    grid = rows // block
-
-    x_in = x2d.astype(kernel_dtype(x2d.dtype))
-    ins = [x_in]
-    in_specs = [pl.BlockSpec((block, hidden), lambda i: (i, 0))]
-    if affine:
-        gb_spec = pl.BlockSpec((1, hidden), lambda i: (0, 0))
-        ins += [
-            weight.reshape(1, hidden).astype(kernel_dtype(weight.dtype)),
-            bias.reshape(1, hidden).astype(kernel_dtype(bias.dtype)),
-        ]
-        in_specs += [gb_spec, gb_spec]
-
-    y, mu, rs = pallas_call(
-        functools.partial(_ln_fwd_kernel, affine, eps),
-        grid=(grid,),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((block, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((block, 1), lambda i: (i, 0)),
-            pl.BlockSpec((block, 1), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(out_dtype)),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-        ],
-    )(*ins)
-    return (
-        y[:rows0].astype(out_dtype),
-        mu[:rows0, 0],
-        rs[:rows0, 0],
-    )
+    y, _, mu, rs = _ln_fwd_impl(x2d, None, weight, bias, eps, out_dtype)
+    return y, mu, rs
 
 
 # ---------------------------------------------------------------------------
@@ -122,7 +165,10 @@ def layer_norm_fwd(
 # ---------------------------------------------------------------------------
 
 
-def _ln_bwd_kernel(affine, x_ref, dy_ref, mu_ref, rs_ref, *refs):
+def _ln_bwd_kernel(affine, has_ds, x_ref, dy_ref, *refs):
+    refs = list(refs)
+    ds_ref = refs.pop(0) if has_ds else None
+    mu_ref, rs_ref = refs.pop(0), refs.pop(0)
     if affine:
         g_ref, dx_ref, dg_ref, db_ref = refs
     else:
@@ -148,12 +194,17 @@ def _ln_bwd_kernel(affine, x_ref, dy_ref, mu_ref, rs_ref, *refs):
         dyg = dy
     c1 = jnp.mean(dyg, axis=1, keepdims=True)
     c2 = jnp.mean(dyg * xhat, axis=1, keepdims=True)
-    dx_ref[...] = (rs * (dyg - c1 - xhat * c2)).astype(dx_ref.dtype)
+    dx = rs * (dyg - c1 - xhat * c2)
+    if has_ds:
+        # the residual stream's cotangent rides the same pass
+        dx = dx + ds_ref[...].astype(jnp.float32)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
 
 
-def _layer_norm_bwd(affine, eps, res, dy):
+def _layer_norm_bwd(affine, eps, res, dy, ds=None):
     x2d, weight, mu, rs = res
     rows0, hidden = x2d.shape
+    has_ds = ds is not None
     block = _block_rows(hidden)
     x_p, _ = _pad_rows(x2d, block)
     dy_p, _ = _pad_rows(dy, block)
@@ -162,19 +213,20 @@ def _layer_norm_bwd(affine, eps, res, dy):
     mu_p = jnp.pad(mu.reshape(-1, 1), ((0, rows - rows0), (0, 0)))
     rs_p = jnp.pad(rs.reshape(-1, 1), ((0, rows - rows0), (0, 0)))
 
+    row_spec = pl.BlockSpec((block, hidden), lambda i: (i, 0))
+    col_spec = pl.BlockSpec((block, 1), lambda i: (i, 0))
     ins = [
         x_p.astype(kernel_dtype(x_p.dtype)),
         dy_p.astype(kernel_dtype(dy_p.dtype)),
-        mu_p,
-        rs_p,
     ]
-    in_specs = [
-        pl.BlockSpec((block, hidden), lambda i: (i, 0)),
-        pl.BlockSpec((block, hidden), lambda i: (i, 0)),
-        pl.BlockSpec((block, 1), lambda i: (i, 0)),
-        pl.BlockSpec((block, 1), lambda i: (i, 0)),
-    ]
-    out_specs = [pl.BlockSpec((block, hidden), lambda i: (i, 0))]
+    in_specs = [row_spec, row_spec]
+    if has_ds:
+        ds_p, _ = _pad_rows(ds, block)
+        ins.append(ds_p.astype(kernel_dtype(ds_p.dtype)))
+        in_specs.append(row_spec)
+    ins += [mu_p, rs_p]
+    in_specs += [col_spec, col_spec]
+    out_specs = [row_spec]
     out_shape = [jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(x2d.dtype))]
     if affine:
         ins.append(weight.reshape(1, hidden).astype(kernel_dtype(weight.dtype)))
@@ -189,7 +241,7 @@ def _layer_norm_bwd(affine, eps, res, dy):
         ]
 
     outs = pallas_call(
-        functools.partial(_ln_bwd_kernel, affine),
+        functools.partial(_ln_bwd_kernel, affine, has_ds),
         grid=(grid,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -247,3 +299,39 @@ def _ln_bwd_rule(eps, res, dy):
 
 
 layer_norm.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def layer_norm_residual_affine(
+    x2d, delta2d, weight, bias, eps, out_dtype=None
+):
+    """(LN(x+delta), x+delta) in ONE kernel on (rows, hidden) views.
+
+    Returns ``(y, s)``: ``s`` is the new residual stream, ``y`` its
+    affine layer norm (dtype ``out_dtype``, default x's). The backward
+    folds the stream cotangent into the dx pass, so the standalone
+    residual-add disappears from both directions; dx == ddelta up to
+    each input's own dtype (the add fans out).
+    """
+    y, s, _, _ = _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype)
+    return y, s
+
+
+def _lnr_fwd(x2d, delta2d, weight, bias, eps, out_dtype):
+    y, s, mu, rs = _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype)
+    # s carries x2d's dtype; a zero-size witness carries delta2d's
+    # (residuals must be JAX values, not dtype objects)
+    d_witness = jnp.zeros((0,), delta2d.dtype)
+    return (y, s), (s, weight, mu, rs, d_witness)
+
+
+def _lnr_bwd(eps, out_dtype, res, cts):
+    dy, ds = cts
+    s, weight, mu, rs, d_witness = res
+    dx, dg, db = _layer_norm_bwd(
+        True, eps, (s, weight, mu, rs), dy, ds=ds
+    )
+    return dx.astype(s.dtype), dx.astype(d_witness.dtype), dg, db
+
+
+layer_norm_residual_affine.defvjp(_lnr_fwd, _lnr_bwd)

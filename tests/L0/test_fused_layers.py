@@ -94,6 +94,86 @@ class TestLayerNorm:
         y = mod.apply(params, x)
         assert y.dtype == jnp.bfloat16
 
+    def test_residual_fused_matches_unfused(self):
+        """(LN(x+d), x+d) from the fused kernel == add-then-LN, values
+        AND gradients through both outputs (incl. the stream cotangent
+        folded into the backward pass)."""
+        x = jax.random.normal(jax.random.PRNGKey(3), (24, 64))
+        d = jax.random.normal(jax.random.PRNGKey(4), (24, 64))
+        w = jax.random.normal(jax.random.PRNGKey(5), (64,)) + 1.0
+        b = jax.random.normal(jax.random.PRNGKey(6), (64,))
+
+        y, s = ln_ops.layer_norm_residual_affine(x, d, w, b, 1e-5)
+        np.testing.assert_allclose(
+            np.asarray(s), np.asarray(x + d), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(ref_ln(x + d, w, b)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+        def fused(x, d, w, b):
+            y, s = ln_ops.layer_norm_residual_affine(x, d, w, b, 1e-5)
+            # both outputs contribute distinct cotangents
+            return jnp.sum(jnp.sin(y)) + jnp.sum(jnp.cos(s) * 0.5)
+
+        def ref(x, d, w, b):
+            s = x + d
+            return jnp.sum(jnp.sin(ref_ln(s, w, b))) + jnp.sum(
+                jnp.cos(s) * 0.5
+            )
+
+        gf = jax.grad(fused, argnums=(0, 1, 2, 3))(x, d, w, b)
+        gr = jax.grad(ref, argnums=(0, 1, 2, 3))(x, d, w, b)
+        for a, e in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4
+            )
+
+    def test_residual_mixed_input_dtypes_grad(self):
+        """x and delta may differ in dtype (fp32 stream + bf16 delta):
+        each cotangent must come back in its own input's dtype
+        (round-2 review: a shared dx array broke jax.grad here)."""
+        x = jax.random.normal(jax.random.PRNGKey(10), (8, 32), jnp.float32)
+        d = jax.random.normal(jax.random.PRNGKey(11), (8, 32), jnp.bfloat16)
+        w = jnp.ones((32,))
+        b = jnp.zeros((32,))
+
+        def f(x, d):
+            y, s = ln_ops.layer_norm_residual_affine(x, d, w, b, 1e-5)
+            return jnp.sum(y.astype(jnp.float32) ** 2) + jnp.sum(
+                s.astype(jnp.float32)
+            )
+
+        gx, gd = jax.grad(f, (0, 1))(x, d)
+        assert gx.dtype == jnp.float32
+        assert gd.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(gx)).all()
+
+    def test_residual_shape_validation(self):
+        from rocm_apex_tpu.normalization.fused_layer_norm import (
+            mixed_dtype_fused_layer_norm_residual_affine as lnr,
+        )
+
+        x = jnp.zeros((2, 4, 32))
+        with pytest.raises(ValueError, match="shapes differ"):
+            lnr(x, jnp.zeros((2, 5, 32)), jnp.ones(32), jnp.zeros(32), 32)
+        with pytest.raises(ValueError, match="normalized_shape"):
+            lnr(x, x, jnp.ones(16), jnp.zeros(16), 16)
+
+    def test_residual_module_form(self):
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, 8, 32), jnp.bfloat16)
+        d = jax.random.normal(jax.random.PRNGKey(8), (2, 8, 32), jnp.bfloat16)
+        mod = MixedFusedLayerNorm(normalized_shape=32)
+        params = mod.init(jax.random.PRNGKey(9), x)
+        y, s = mod.apply(params, d, residual=x)
+        assert y.dtype == jnp.float32  # follows fp32 params
+        assert s.dtype == jnp.bfloat16  # stream follows the input
+        np.testing.assert_allclose(
+            np.asarray(s, np.float32),
+            np.asarray((x + d).astype(jnp.bfloat16), np.float32),
+        )
+
 
 class TestScaledSoftmax:
     def test_causal_matches_masked_jax(self):
