@@ -271,190 +271,26 @@ def _one_pass_1f1b(
     stage_fn, loss_fn, local_params, inputs, targets, axis,
     extra, pre_fn, has_extra,
 ):
-    """True 1F1B: ONE non-differentiated scan interleaving a forward
-    and a backward unit per tick, with O(P) live activations.
+    """True 1F1B with O(P) live activations: ONE non-differentiated
+    scan interleaving a forward and a backward unit per tick.
 
     Differentiating a forward scan (the previous implementation) saves
     the carried activation at EVERY tick for the transpose — O(M)
-    memory, defeating 1F1B's point. Here gradients are constructed
-    inside the scan instead (reference semantics:
-    fwd_bwd_pipelining_without_interleaving.py:22-170):
-
-    * tick ``t``, rank ``s``: forward of microbatch ``jf = t − s`` and
-      backward of ``jb = t − (2(P−1) − s)`` — the exit stage backwards
-      a microbatch the same tick it forwards it, stage 0 a full
-      2(P−1) ticks later: exactly the reference's warmup/steady/
-      cooldown profile, as validity masks;
-    * stage INPUTS wait in a circular buffer of ``2(P−1)`` slots (the
-      1F1B in-flight bound; the exit stage stores nothing) and the
-      backward unit rematerializes the stage forward from the saved
-      input via `jax.vjp` — same recompute count as the old
-      checkpointed transpose, without its O(ticks) carry history;
-    * activation cotangents ride a REVERSE ppermute; the exit stage
-      seeds them from the head/loss VJP (cotangent 1/M = the mean);
-      shared-param (embedding/head) cotangents accumulate on the
-      ranks that own those computations and are psum'd by the caller.
-
-    Gradients accumulate in fp32 and are cast to the param dtype at
-    the end. Returns (losses (M,), grads, extra_grads | None).
+    memory, defeating 1F1B's point. The linear pipeline is exactly the
+    vp = 1 case of the circular one (`_one_pass_interleaved`: tick
+    algebra degenerates to forward of microbatch t−s and backward of
+    t−(2(P−1)−s); the ring's wrap edges carry only data masked off by
+    the entry/exit selects), so it delegates there with a singleton
+    chunk axis. Gradients accumulate in fp32 and are cast to the param
+    dtype; returns (losses (M,), grads, extra_grads | None).
     """
-    p = jax.lax.axis_size(axis)
-    m = inputs.shape[0]
-    rank = jax.lax.axis_index(axis)
-    is_first = rank == 0
-    is_last = rank == p - 1
-    fwd_perm = [(i, i + 1) for i in range(p - 1)]
-    bwd_perm = [(i + 1, i) for i in range(p - 1)]
-    nslots = max(1, 2 * (p - 1))
-    ticks = m + 2 * (p - 1)
-
-    in0 = jax.eval_shape(lambda x: x[0], inputs)
-    if pre_fn is None:
-        a0 = in0
-    else:
-        a0 = jax.eval_shape(pre_fn, extra, in0)
-
-    def varying(x):
-        return jax.tree_util.tree_map(lambda v: _pcast_varying(v, axis), x)
-
-    def zeros_of(shape_tree, dtype=None):
-        return jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, dtype or s.dtype), shape_tree
-        )
-
-    def tick(carry, t):
-        act_recv, ct_recv, x_buf, g_acc, eg_acc, losses = carry
-
-        # ---- forward unit: microbatch jf -------------------------------
-        jf = t - rank
-        fwd_valid = (jf >= 0) & (jf < m)
-        jf_c = jnp.clip(jf, 0, m - 1)
-        inp_j = _tree_idx(inputs, jf_c)
-        x0 = pre_fn(extra, inp_j) if pre_fn is not None else inp_j
-        x_in = jnp.where(is_first, _pcast_varying(x0, axis), act_recv)
-        y = stage_fn(local_params, x_in)
-
-        # exit-stage post_process: loss value + initial cotangent dy,
-        # under a rank cond (non-exit ranks never run or differentiate
-        # the head — see _head_losses for why cond, not select). The
-        # extra-grad accumulator threads THROUGH the cond so the
-        # full-embedding-sized add happens only on the exit rank's
-        # valid ticks (outside, every rank would add a zero tree the
-        # size of the embedding every tick).
-        tgt_j = _tree_idx(targets, jf_c)
-        ct1 = _pcast_varying(jnp.asarray(1.0 / m, jnp.float32), axis)
-
-        def _head():
-            if has_extra:
-                def lf(e, yy):
-                    return loss_fn(e, yy, tgt_j).astype(jnp.float32)
-
-                loss, pull = jax.vjp(lf, extra, y)
-                de, dy = pull(ct1)
-                eg2 = jax.tree_util.tree_map(
-                    lambda a, d: a + d.astype(jnp.float32), eg_acc, de
-                )
-                return varying((loss, dy)), eg2
-
-            def lf(yy):
-                return loss_fn(yy, tgt_j).astype(jnp.float32)
-
-            loss, pull = jax.vjp(lf, y)
-            (dy,) = pull(ct1)
-            return varying((loss, dy)), eg_acc
-
-        def _nohead():
-            return (
-                varying(
-                    (
-                        jnp.zeros((), jnp.float32),
-                        jnp.zeros(y.shape, y.dtype),
-                    )
-                ),
-                eg_acc,
-            )
-
-        (loss_j, dy), eg_acc = jax.lax.cond(
-            is_last & fwd_valid, _head, _nohead
-        )
-        losses = losses.at[jf_c].set(
-            jnp.where(is_last & fwd_valid, loss_j, losses[jf_c])
-        )
-
-        # ---- backward unit: microbatch jb ------------------------------
-        jb = t - (2 * (p - 1) - rank)
-        bwd_valid = (jb >= 0) & (jb < m)
-        jb_c = jnp.clip(jb, 0, m - 1)
-        slot_b = jb_c % nslots
-        # the exit stage backwards the microbatch it just forwarded
-        # (its lifetime is zero — no buffer slot ever written there)
-        x_saved = jnp.where(is_last, x_in, x_buf[slot_b])
-        ct_in = jnp.where(is_last, dy.astype(y.dtype), ct_recv)
-        _, pull = jax.vjp(stage_fn, local_params, x_saved)
-        dp_j, dx_j = pull(ct_in)
-        g_acc = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(
-                bwd_valid, d.astype(jnp.float32), 0.0
-            ),
-            g_acc,
-            dp_j,
-        )
-
-        # entry-stage pre_process backward (embedding cotangents),
-        # accumulator threaded through the cond for the same reason
-        if has_extra and pre_fn is not None:
-            inp_b = _tree_idx(inputs, jb_c)
-
-            def _pre_bwd():
-                _, pullE = jax.vjp(lambda e: pre_fn(e, inp_b), extra)
-                (deE,) = pullE(dx_j)
-                return jax.tree_util.tree_map(
-                    lambda a, d: a + d.astype(jnp.float32), eg_acc, deE
-                )
-
-            eg_acc = jax.lax.cond(
-                is_first & bwd_valid, _pre_bwd, lambda: eg_acc
-            )
-
-        # ---- buffer + ring transfers ----------------------------------
-        slot_f = jf_c % nslots
-        x_buf = x_buf.at[slot_f].set(
-            jnp.where(fwd_valid & ~is_last, x_in, x_buf[slot_f])
-        )
-        act_send = jax.lax.ppermute(y, axis, fwd_perm)
-        ct_send = jax.lax.ppermute(
-            jnp.where(bwd_valid, dx_j, jnp.zeros_like(dx_j)),
-            axis,
-            bwd_perm,
-        )
-        return (act_send, ct_send, x_buf, g_acc, eg_acc, losses), None
-
-    act0 = varying(jnp.zeros(a0.shape, a0.dtype))
-    ct0 = varying(jnp.zeros(a0.shape, a0.dtype))
-    xbuf0 = varying(jnp.zeros((nslots,) + a0.shape, a0.dtype))
-    g0 = varying(zeros_of(local_params, jnp.float32))
-    eg0 = varying(zeros_of(extra, jnp.float32)) if has_extra else ()
-    losses0 = varying(jnp.zeros((m,), jnp.float32))
-
-    (_, _, _, g_acc, eg_acc, losses), _ = jax.lax.scan(
-        tick,
-        (act0, ct0, xbuf0, g0, eg0, losses0),
-        jnp.arange(ticks),
+    stacked = jax.tree_util.tree_map(lambda x: x[None], local_params)
+    losses, grads, egrads = _one_pass_interleaved(
+        stage_fn, loss_fn, stacked, inputs, targets, axis,
+        extra, pre_fn, has_extra, 1,
     )
-    grads = jax.tree_util.tree_map(
-        lambda g, pp: g.astype(pp.dtype), g_acc, local_params
-    )
-    losses = _replicate_masked(
-        losses, is_last.astype(losses.dtype), axis
-    )
-    if has_extra:
-        egrads = jax.tree_util.tree_map(
-            lambda g, e: jax.lax.psum(g, axis).astype(e.dtype),
-            eg_acc,
-            extra,
-        )
-        return losses, grads, egrads
-    return losses, grads, None
+    grads = jax.tree_util.tree_map(lambda g: jnp.squeeze(g, 0), grads)
+    return losses, grads, egrads
 
 
 def forward_backward_pipelining_without_interleaving(
@@ -557,6 +393,229 @@ def forward_backward_pipelining_without_interleaving(
     return losses, grads
 
 
+def _one_pass_interleaved(
+    stage_fn, loss_fn, params, inputs, targets, axis,
+    extra, pre_fn, has_extra, vp,
+):
+    """One-pass interleaved 1F1B: the circular pipeline with gradients
+    built inside a single non-differentiated scan (the `_one_pass_1f1b`
+    scheme generalized to vp model chunks per rank).
+
+    Geometry (global stage ``g = v·P + s``, ``G = vp·P``,
+    ``L = P·vp``): forward of unit (m, v) runs on rank s at
+    ``t_f = (m//P)·L + v·P + m%P + s`` (the round-robin order of the
+    forward-only schedule) and its backward at
+    ``t_b = t_f + 2·(G−1−g)``, i.e. ``t_b − 2(G−1) + s =
+    (m//P)·L + m%P − v·P`` — decoded per tick by the same mod-L
+    arithmetic. Cotangents ride ONE reverse ring permute
+    ``i → (i−1) mod P``: a step within a chunk moves g+1 → g on the
+    next rank down, and the wrap P−1 ← 0 decrements the chunk — the
+    mirror image of the forward's wrap-around hand-off.
+
+    Stage inputs wait in a ``2(G−1)+1``-slot ring keyed by forward
+    tick (one unit per rank per tick, lifetime ≤ 2(G−1)); the exit
+    unit (g = G−1) backwards the tick it forwards, so live activations
+    are bounded by the schedule depth O(P·vp) — the interleaved
+    1F1B's documented in-flight profile — instead of the O(M·vp)
+    carry history of a differentiated scan.
+    """
+    p = jax.lax.axis_size(axis)
+    m = inputs.shape[0]
+    rank = jax.lax.axis_index(axis)
+    is_first = rank == 0
+    is_last = rank == p - 1
+    L = p * vp
+    G = vp * p
+    ring = [(i, (i + 1) % p) for i in range(p)]
+    rring = [(i, (i - 1) % p) for i in range(p)]
+    nslots = 2 * (G - 1) + 1
+    ticks = ((m - 1) // p) * L + (m - 1) % p + 2 * (G - 1) + 1
+
+    in0 = jax.eval_shape(lambda x: x[0], inputs)
+    a0 = in0 if pre_fn is None else jax.eval_shape(pre_fn, extra, in0)
+
+    def varying(x):
+        return jax.tree_util.tree_map(lambda v: _pcast_varying(v, axis), x)
+
+    def zeros_of(shape_tree, dtype=None):
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, dtype or s.dtype), shape_tree
+        )
+
+    def chunk_at(tree, v):
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, v, 0, keepdims=False),
+            tree,
+        )
+
+    def decode_bwd(t):
+        """tick -> (m_b, v_b, valid): invert t_b's round-robin form."""
+        r = t - 2 * (G - 1) + rank
+        rnd = jnp.floor_divide(r, L)
+        rr = r - rnd * L  # in [0, L)
+        # rr = m%p - v*p (v=0 branch) or L + m%p - v*p (v>0 branch)
+        in_v0 = rr < p
+        v_pos = jnp.floor_divide(L - rr + p - 1, p)
+        v_b = jnp.where(in_v0, 0, v_pos)
+        mp = jnp.where(in_v0, rr, v_pos * p - (L - rr))
+        rnd_b = jnp.where(in_v0, rnd, rnd + 1)
+        m_b = rnd_b * p + mp
+        # r itself may be negative for early microbatches of higher
+        # chunks (m%p - v*p < 0); the mb bound is the real validity
+        valid = (m_b >= 0) & (m_b < m) & (v_b < vp)
+        return m_b, v_b, valid
+
+    def tick(carry, t):
+        act_recv, ct_recv, x_buf, g_acc, eg_acc, losses = carry
+
+        # ---- forward unit (current schedule's decomposition) -----------
+        r = t - rank
+        rnd, rr = r // L, r % L
+        v_f = rr // p
+        m_f = rnd * p + rr % p
+        fwd_valid = (r >= 0) & (m_f >= 0) & (m_f < m)
+        v_fc = jnp.clip(v_f, 0, vp - 1)
+        m_fc = jnp.clip(m_f, 0, m - 1)
+        chunk = chunk_at(params, v_fc)
+        inp_j = _tree_idx(inputs, m_fc)
+        is_entry = is_first & (v_fc == 0)
+        if pre_fn is None:
+            x0 = _pcast_varying(inp_j, axis)
+        else:
+            # embedding only on the entry rank's valid v=0 ticks: the
+            # cond skips a full vocab-gather per tick on every other
+            # rank (its result would be discarded by the select below)
+            x0 = jax.lax.cond(
+                is_entry & fwd_valid,
+                lambda: _pcast_varying(pre_fn(extra, inp_j), axis),
+                lambda: _pcast_varying(
+                    jnp.zeros(a0.shape, a0.dtype), axis
+                ),
+            )
+        x_in = jnp.where(is_entry, x0, act_recv)
+        y = stage_fn(chunk, x_in)
+
+        # exit-unit post_process (global stage G-1)
+        is_exit = is_last & (v_fc == vp - 1) & fwd_valid
+        tgt_j = _tree_idx(targets, m_fc)
+        ct1 = _pcast_varying(jnp.asarray(1.0 / m, jnp.float32), axis)
+
+        def _head():
+            if has_extra:
+                def lf(e, yy):
+                    return loss_fn(e, yy, tgt_j).astype(jnp.float32)
+
+                loss, pull = jax.vjp(lf, extra, y)
+                de, dy = pull(ct1)
+                eg2 = jax.tree_util.tree_map(
+                    lambda a, d: a + d.astype(jnp.float32), eg_acc, de
+                )
+                return varying((loss, dy)), eg2
+
+            def lf(yy):
+                return loss_fn(yy, tgt_j).astype(jnp.float32)
+
+            loss, pull = jax.vjp(lf, y)
+            (dy,) = pull(ct1)
+            return varying((loss, dy)), eg_acc
+
+        def _nohead():
+            return (
+                varying(
+                    (
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros(y.shape, y.dtype),
+                    )
+                ),
+                eg_acc,
+            )
+
+        (loss_j, dy), eg_acc = jax.lax.cond(is_exit, _head, _nohead)
+        losses = losses.at[m_fc].set(
+            jnp.where(is_exit, loss_j, losses[m_fc])
+        )
+
+        # ---- backward unit --------------------------------------------
+        m_b, v_b, bwd_valid = decode_bwd(t)
+        v_bc = jnp.clip(v_b, 0, vp - 1)
+        m_bc = jnp.clip(m_b, 0, m - 1)
+        g_b = v_bc * p + rank
+        t_f_b = t - 2 * (G - 1 - g_b)
+        slot_b = jnp.clip(t_f_b, 0, None) % nslots
+        bwd_is_exit = is_last & (v_bc == vp - 1)
+        x_saved = jnp.where(bwd_is_exit, x_in, x_buf[slot_b])
+        ct_in = jnp.where(bwd_is_exit, dy.astype(y.dtype), ct_recv)
+        bchunk = chunk_at(params, v_bc)
+        _, pull = jax.vjp(stage_fn, bchunk, x_saved)
+        dp_j, dx_j = pull(ct_in)
+        g_acc = jax.tree_util.tree_map(
+            lambda a, d: jax.lax.dynamic_update_index_in_dim(
+                a,
+                jax.lax.dynamic_index_in_dim(a, v_bc, 0, keepdims=False)
+                + jnp.where(bwd_valid, d.astype(jnp.float32), 0.0),
+                v_bc,
+                0,
+            ),
+            g_acc,
+            dp_j,
+        )
+
+        if has_extra and pre_fn is not None:
+            inp_b = _tree_idx(inputs, m_bc)
+
+            def _pre_bwd():
+                _, pullE = jax.vjp(lambda e: pre_fn(e, inp_b), extra)
+                (deE,) = pullE(dx_j)
+                return jax.tree_util.tree_map(
+                    lambda a, d: a + d.astype(jnp.float32), eg_acc, deE
+                )
+
+            eg_acc = jax.lax.cond(
+                is_first & (v_bc == 0) & bwd_valid,
+                _pre_bwd,
+                lambda: eg_acc,
+            )
+
+        # ---- buffer + ring transfers (slots keyed by forward tick) ----
+        slot_f = t % nslots
+        x_buf = x_buf.at[slot_f].set(
+            jnp.where(
+                fwd_valid & ~(is_last & (v_fc == vp - 1)), x_in,
+                x_buf[slot_f],
+            )
+        )
+        act_send = jax.lax.ppermute(y, axis, ring)
+        ct_send = jax.lax.ppermute(
+            jnp.where(bwd_valid, dx_j, jnp.zeros_like(dx_j)), axis, rring
+        )
+        return (act_send, ct_send, x_buf, g_acc, eg_acc, losses), None
+
+    act0 = varying(jnp.zeros(a0.shape, a0.dtype))
+    ct0 = varying(jnp.zeros(a0.shape, a0.dtype))
+    xbuf0 = varying(jnp.zeros((nslots,) + a0.shape, a0.dtype))
+    g0 = varying(zeros_of(params, jnp.float32))
+    eg0 = varying(zeros_of(extra, jnp.float32)) if has_extra else ()
+    losses0 = varying(jnp.zeros((m,), jnp.float32))
+
+    (_, _, _, g_acc, eg_acc, losses), _ = jax.lax.scan(
+        tick,
+        (act0, ct0, xbuf0, g0, eg0, losses0),
+        jnp.arange(ticks),
+    )
+    grads = jax.tree_util.tree_map(
+        lambda g, pp: g.astype(pp.dtype), g_acc, params
+    )
+    losses = _replicate_masked(losses, is_last.astype(losses.dtype), axis)
+    if has_extra:
+        egrads = jax.tree_util.tree_map(
+            lambda g, e: jax.lax.psum(g, axis).astype(e.dtype),
+            eg_acc,
+            extra,
+        )
+        return losses, grads, egrads
+    return losses, grads, None
+
+
 def forward_backward_pipelining_with_interleaving(
     stage_fn: StageFn,
     loss_fn: LossFn,
@@ -656,18 +715,10 @@ def forward_backward_pipelining_with_interleaving(
     if forward_only:
         _, losses = run(params, extra_params)
         return losses, None
-    if has_extra:
-        (_, losses), (grads, egrads) = jax.value_and_grad(
-            run, argnums=(0, 1), has_aux=True
-        )(params, extra_params)
-        egrads = jax.lax.psum(
-            jax.tree_util.tree_map(
-                lambda g: _pcast_varying(g, axis), egrads
-            ),
-            axis,
-        )
-        return losses, (grads, egrads)
-    (_, losses), grads = jax.value_and_grad(run, has_aux=True)(
-        params, extra_params
+    losses, grads, egrads = _one_pass_interleaved(
+        stage_fn, loss_fn, params, inputs, targets, axis,
+        extra_params, pre_fn, has_extra, vp,
     )
+    if has_extra:
+        return losses, (grads, egrads)
     return losses, grads

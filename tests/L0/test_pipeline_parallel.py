@@ -723,3 +723,45 @@ class TestOnePass1F1BMemoryBound:
             f"temp grew by {(b_large - b_small) / act_bytes:.1f} "
             f"activations from M=16 to M=64 — O(M) memory is back"
         )
+
+    def test_interleaved_temp_memory_flat_in_m(self, eight_devices):
+        """Same bound for the circular pipeline: temp memory must not
+        scale with M now that the interleaved schedule also builds
+        gradients inside one non-differentiated scan."""
+        mesh = pipe_mesh(eight_devices)
+        vp = 2
+
+        def temp_bytes(m):
+            params = {
+                "w": jnp.zeros((PP, vp, D, D)),
+                "b": jnp.zeros((PP, vp, D)),
+            }
+            x = jnp.zeros((m, MB, D))
+            t = jnp.zeros((m, MB, D))
+            f = shard_map(
+                lambda p, x, t: forward_backward_pipelining_with_interleaving(
+                    stage_fn,
+                    loss_fn,
+                    jax.tree_util.tree_map(lambda v: v[0], p),
+                    x,
+                    t,
+                    axis_name="pipe",
+                ),
+                mesh=mesh,
+                in_specs=(P("pipe"), P(), P()),
+                out_specs=(P(), P("pipe")),
+                check_rep=False,
+            )
+            compiled = jax.jit(f).lower(params, x, t).compile()
+            ma = compiled.memory_analysis()
+            if ma is None:
+                pytest.skip("backend reports no memory analysis")
+            return ma.temp_size_in_bytes
+
+        b_small = temp_bytes(16)
+        b_large = temp_bytes(64)
+        act_bytes = MB * D * 4
+        assert b_large - b_small < 8 * act_bytes, (
+            f"temp grew by {(b_large - b_small) / act_bytes:.1f} "
+            f"activations from M=16 to M=64 — O(M) memory is back"
+        )
