@@ -16,18 +16,21 @@ activations hop stages via `lax.ppermute`:
   the permute hands its output to ``s+1`` — exactly the reference's
   pipeline diagram, with warmup/steady/cooldown appearing as the
   triangular valid-regions of the scan rather than as python phases;
-* the *backward* pipeline is not written at all: differentiating the
-  scan transposes every ppermute (reverse direction) and replays the
-  ticks in reverse order, which IS the cooldown phase;
-* 1F1B's raison d'être — bounding live activations to P microbatches
-  instead of M — is delivered by `jax.checkpoint` on the stage body
-  (`checkpoint_stages=True`): residuals per tick shrink to the carried
-  activation, and XLA rematerializes during the transposed scan;
-* the interleaved schedule becomes a *circular* pipeline: each stage
-  holds ``vp`` model chunks, the permute wraps P−1 → 0, and crossing
-  the wrap advances the chunk index — same unit ordering as the
-  reference's `num_warmup` doubling / chunk-id scheduling, derived from
-  the closed-form tick formula instead of bookkeeping.
+* training runs the TRUE 1F1B: ONE non-differentiated scan interleaves
+  a forward and a backward unit per tick (`_one_pass_interleaved`),
+  building gradients inside the scan via per-tick `jax.vjp` — stage
+  inputs wait in an O(P)-slot ring, activation cotangents ride a
+  reverse ppermute, and live activations are bounded by the pipeline
+  depth, not the microbatch count (differentiating the forward scan —
+  the previous design — saved the carry at every tick: O(M));
+* `forward_only` keeps the plain forward scan, whose transpose is
+  never taken;
+* the interleaved schedule is the same program over a *circular*
+  pipeline: each stage holds ``vp`` model chunks, the permute wraps
+  P−1 → 0, and crossing the wrap advances the chunk index — same unit
+  ordering as the reference's `num_warmup` doubling / chunk-id
+  scheduling, derived from the closed-form tick formula instead of
+  bookkeeping. The linear schedule is its vp = 1 degenerate case.
 
 All schedule functions share one signature (the reference's share theirs
 via `forward_step_func`):
