@@ -120,6 +120,53 @@ class TestDCGAN:
 
 
 class TestGPT:
+    def test_chained_residuals_match_eager_layers(self):
+        """The pre-LN stack's delta-chaining (every residual add fused
+        into a LN kernel) must be numerically identical to composing
+        the layers eagerly (chain=False, the pipeline contract),
+        forward AND gradients — pins the fused-LN delta bookkeeping."""
+        from rocm_apex_tpu.models.gpt import (
+            ParallelTransformer,
+            ParallelTransformerLayer,
+        )
+
+        # fp32 so both paths are exactly comparable: in bf16 the eager
+        # path rounds each inter-layer sum to bf16 while the fused
+        # kernel sums in fp32 (the chained path is the more precise one)
+        cfg = tiny_gpt_cfg(dtype=jnp.float32, params_dtype=jnp.float32)
+        stack = ParallelTransformer(cfg, num_layers=3, post_layer_norm=False)
+        x = jax.random.normal(
+            jax.random.PRNGKey(20), (2, 16, cfg.hidden_size), jnp.float32
+        )
+        params = stack.init(jax.random.PRNGKey(21), x)
+
+        def chained(params, x):
+            return stack.apply(params, x)
+
+        def eager(params, x):
+            # same params, bare per-layer calls (the pipeline contract)
+            for i in range(3):
+                layer = ParallelTransformerLayer(cfg)
+                sub = {"params": params["params"][f"layer_{i}"]}
+                x = layer.apply(sub, x)
+            return x
+
+        y_c = chained(params, x)
+        y_e = eager(params, x)
+        np.testing.assert_allclose(
+            np.asarray(y_c, np.float32), np.asarray(y_e, np.float32),
+            rtol=1e-5, atol=1e-5,
+        )
+        g_c = jax.grad(lambda p: jnp.sum(chained(p, x) ** 2))(params)
+        g_e = jax.grad(lambda p: jnp.sum(eager(p, x) ** 2))(params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_c), jax.tree_util.tree_leaves(g_e)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-4, atol=1e-5,
+            )
+
     def test_loss_falls(self):
         cfg = tiny_gpt_cfg()
         model = GPTModel(cfg)
