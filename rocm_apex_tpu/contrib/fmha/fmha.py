@@ -64,6 +64,13 @@ def fmha(
     lengths = cu_seqlens[1:] - cu_seqlens[:-1]  # (b,)
     kv_lengths = jnp.repeat(lengths.astype(jnp.int32), h)  # (b*h,)
 
+    # INVARIANT: rows with kv_lengths == 0 have UNSPECIFIED output from
+    # flash_attention_varlen (its docstring reserves them). A zero-length
+    # sequence in cu_seqlens contributes no packed tokens, so the gather
+    # below never reads such a row — every gathered (seq_id, offset)
+    # satisfies offset < lengths[seq_id]. Future callers of
+    # flash_attention_varlen must preserve this: never consume rows
+    # beyond their kv bound.
     ctx = flash_attention_varlen(q, k, v, kv_lengths, causal, scale)
     ctx = ctx.reshape(b, h, max_s, d).transpose(0, 2, 1, 3)  # (b, s, h, d)
     return ctx[seq_id, offset]

@@ -80,7 +80,9 @@ class OpStat(
             "bytes",        # total HBM bytes moved (operands + outputs)
             "tflops_sec",   # achieved TFLOP/s over the row's device time
             "gb_sec",       # achieved GB/s over the row's device time
-            "pct_peak",     # roofline % of peak: max(flops-, bytes-bound)
+            "pct_peak",     # roofline % of peak: max(flops-, bytes-bound);
+                            # 0.0 when device_kind is not in _CHIP_PEAKS
+                            # (no made-up placeholder peaks)
         ],
     )
 ):
@@ -233,7 +235,7 @@ def _probe_device_kind() -> str:
             _probed_kind = getattr(
                 jax.devices()[0], "device_kind", ""
             ).lower()
-        except Exception:  # no live backend: default peaks apply
+        except Exception:  # no live backend: kind unknown, pct_peak=0.0
             _probed_kind = ""
     return _probed_kind
 
@@ -274,11 +276,14 @@ def op_stats(
 
     if device_kind is None:
         device_kind = _probe_device_kind()
-    peak_f, peak_b = 1e12, 100e9
+    peak_f = peak_b = None
+    device_kind = device_kind.lower()  # _probe_device_kind lowercases too
     for key, (pf, pb) in _CHIP_PEAKS.items():
         if key in device_kind:
             peak_f, peak_b = pf, pb
             break
+    # unknown chip: pct_peak stays 0.0 rather than being computed
+    # against made-up peaks (achieved TFLOP/s + GB/s columns still hold)
 
     tot = collections.Counter()
     cnt = collections.Counter()
@@ -313,10 +318,13 @@ def op_stats(
         sec = ms / 1e3
         tf = flops[n] / sec / 1e12 if sec else 0.0
         gb = nbytes[n] / sec / 1e9 if sec else 0.0
-        pct = max(
-            flops[n] / sec / peak_f if sec else 0.0,
-            nbytes[n] / sec / peak_b if sec else 0.0,
-        ) * 100.0
+        if peak_f is None or not sec:
+            pct = 0.0
+        else:
+            pct = max(
+                flops[n] / sec / peak_f,
+                nbytes[n] / sec / peak_b,
+            ) * 100.0
         return OpStat(
             n, ms, cnt[n], cat.get(n, ""),
             flops[n], nbytes[n], round(tf, 3), round(gb, 2), round(pct, 2),

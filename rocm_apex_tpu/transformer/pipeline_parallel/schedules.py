@@ -63,6 +63,7 @@ Pipelined schedules must run inside shard_map with the ``pipe`` axis
 bound; `forward_backward_no_pipelining` runs anywhere.
 """
 
+import warnings
 from functools import partial
 from typing import Any, Callable, Optional
 
@@ -144,7 +145,13 @@ def _head_losses(loss_fn, has_extra, extra, y_buf, targets, axis, is_last):
     into non-exit gradients via the 0·Inf of a masked-output transpose.
     The predicate depends only on the pipe rank, so any collective
     inside loss_fn (e.g. the vocab-parallel CE's tensor-axis psum) sees
-    a uniform decision within its device group."""
+    a uniform decision within its device group.
+
+    NOTE: the predicate VARIES over the pipe axis, so this `cond` (and
+    the per-tick head in `_one_pass_interleaved`) is only legal under
+    `shard_map(..., check_rep=False)` — every current caller. A future
+    caller with replication checking enabled would see this rejected;
+    it would need `check_rep=False` or a select-based head."""
 
     def one(y, t):
         loss = loss_fn(extra, y, t) if has_extra else loss_fn(y, t)
@@ -320,8 +327,17 @@ def forward_backward_pipelining_without_interleaving(
     keeps the plain forward scan. ``checkpoint_stages`` is accepted
     for API compatibility: the one-pass backward always rematerializes
     the stage from its saved input, which is the same recompute the
-    checkpointed transpose performed.
+    checkpointed transpose performed — passing ``False`` with training
+    enabled cannot disable the recompute, and warns once.
     """
+    if not checkpoint_stages and not forward_only:
+        warnings.warn(
+            "checkpoint_stages=False has no effect on the training "
+            "path: the one-pass 1F1B backward always rematerializes "
+            "each stage from its saved input (O(P) live activations). "
+            "There is no store-all-activations fast path.",
+            stacklevel=2,
+        )
     axis = axis_name or parallel_state.PIPE_AXIS
     p = jax.lax.axis_size(axis)
     m = inputs.shape[0]
@@ -329,7 +345,10 @@ def forward_backward_pipelining_without_interleaving(
     rank = jax.lax.axis_index(axis)
     is_first = rank == 0
     is_last = rank == p - 1
-    body = _maybe_checkpoint(stage_fn, checkpoint_stages)
+    # checkpoint_stages never wraps here: training runs the one-pass
+    # backward (always remats), and the forward_only scan below is
+    # never differentiated, so jax.checkpoint would be a no-op
+    body = stage_fn
     perm = [(i, i + 1) for i in range(p - 1)]
 
     local_params = jax.tree_util.tree_map(
@@ -648,7 +667,16 @@ def forward_backward_pipelining_with_interleaving(
     permute carries every transfer, including the chunk hand-off
     P−1 → 0. Requires M % P == 0, like the reference
     (fwd_bwd_pipelining_with_interleaving.py asserts the same).
+    ``checkpoint_stages=False`` with training enabled warns, as in the
+    linear schedule: the one-pass backward always rematerializes.
     """
+    if not checkpoint_stages and not forward_only:
+        warnings.warn(
+            "checkpoint_stages=False has no effect on the training "
+            "path: the one-pass interleaved backward always "
+            "rematerializes each chunk from its saved input.",
+            stacklevel=2,
+        )
     axis = axis_name or parallel_state.PIPE_AXIS
     p = jax.lax.axis_size(axis)
     m = inputs.shape[0]
@@ -668,7 +696,7 @@ def forward_backward_pipelining_with_interleaving(
     vp = vp_sizes.pop()
     ticks = m * vp + p - 1
     rank = jax.lax.axis_index(axis)
-    body = _maybe_checkpoint(stage_fn, checkpoint_stages)
+    body = stage_fn  # same no-op rationale as the linear schedule
     ring = [(i, (i + 1) % p) for i in range(p)]
     round_len = p * vp
 

@@ -126,7 +126,12 @@ class TestPipelining1F1B:
             in_specs=(P("pipe"), P(), P()),
             out_specs=(P(), P("pipe")),
         )
-        losses, grads = jax.jit(f)(params, inputs, targets)
+        if checkpoint_stages:
+            losses, grads = jax.jit(f)(params, inputs, targets)
+        else:
+            # False is a no-op on the training path and must say so
+            with pytest.warns(UserWarning, match="checkpoint_stages=False"):
+                losses, grads = jax.jit(f)(params, inputs, targets)
         _, exp_losses, exp_grads = serial_reference(params, inputs, targets, PP)
         np.testing.assert_allclose(losses, exp_losses, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(grads["w"], exp_grads["w"], rtol=1e-4, atol=1e-6)

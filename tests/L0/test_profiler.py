@@ -147,7 +147,7 @@ class TestOpStatsEndToEnd:
         with gzip.open(d / "host.trace.json.gz", "wt") as f:
             json.dump({"traceEvents": events}, f)
 
-        stats = op_stats(str(tmp_path))
+        stats = op_stats(str(tmp_path), device_kind="TPU v5e")
         by_name = {s.name: s for s in stats}
         mm = by_name["fusion"]
         assert mm.flops == 2 * 128 * 256 * 512
@@ -157,3 +157,10 @@ class TestOpStatsEndToEnd:
         assert cp.bytes == 4 * 2 * 1024
         assert cp.gb_sec > 0
         assert isinstance(mm, OpStat)
+
+        # unknown hardware: pct_peak must be 0.0 (flagged unknown), not
+        # computed against placeholder peaks; achieved-rate columns hold
+        unk = op_stats(str(tmp_path), device_kind="FPGA x9000")
+        mm_u = {s.name: s for s in unk}["fusion"]
+        assert mm_u.pct_peak == 0.0
+        assert mm_u.tflops_sec == mm.tflops_sec
