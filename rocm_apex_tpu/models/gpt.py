@@ -32,7 +32,7 @@ import numpy as np
 
 from rocm_apex_tpu.normalization import MixedFusedLayerNorm
 from rocm_apex_tpu.ops.flash_attention import flash_attention
-from rocm_apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from rocm_apex_tpu.ops.xentropy import softmax_cross_entropy_loss_fused
 from rocm_apex_tpu.ops.softmax import (
     scaled_masked_softmax,
     scaled_upper_triang_masked_softmax,
@@ -205,19 +205,6 @@ class ParallelAttention(nn.Module):
         hd = cfg.head_dim
         b, sq, _ = x.shape
 
-        qkv, _ = ColumnParallelLinear(
-            cfg.hidden_size,
-            3 * cfg.hidden_size,
-            gather_output=False,
-            init_method=_init(cfg),
-            params_dtype=cfg.params_dtype,
-            dtype=cfg.dtype,
-            world_size=cfg.tensor_parallel_size,
-            axis_name=cfg.tensor_axis,
-            name="query_key_value",
-        )(x)
-        qkv = qkv.reshape(b, sq, nh_local, 3 * hd)
-
         scale = 1.0 / np.sqrt(hd)
         # in-kernel flash dropout needs the TPU PRNG (no interpret-mode
         # lowering) and is not available on the ring (CP) path
@@ -234,6 +221,28 @@ class ParallelAttention(nn.Module):
         use_flash = cfg.attention_impl == "flash" and (
             not dropout_active or use_flash_dropout
         )
+        will_pack = (
+            use_flash
+            and self.attn_mask_type == "causal"
+            and cfg.context_parallel_axis is None
+            and hd % 128 == 0
+        )
+        # packed path: the projection bias rides into the attention
+        # kernels (added on tile load; bias-grad partials emitted from
+        # VMEM in backward) — param structure is unchanged
+        qkv, qkv_bias = ColumnParallelLinear(
+            cfg.hidden_size,
+            3 * cfg.hidden_size,
+            gather_output=False,
+            skip_bias_add=will_pack,
+            init_method=_init(cfg),
+            params_dtype=cfg.params_dtype,
+            dtype=cfg.dtype,
+            world_size=cfg.tensor_parallel_size,
+            axis_name=cfg.tensor_axis,
+            name="query_key_value",
+        )(x)
+        qkv = qkv.reshape(b, sq, nh_local, 3 * hd)
         if cfg.context_parallel_axis is not None and (
             not use_flash or self.attn_mask_type != "causal" or dropout_active
         ):
@@ -253,12 +262,7 @@ class ParallelAttention(nn.Module):
         # straight out of the fused projection output — no split, no
         # transposes, and the context lands output-projection-ready
         # (measured ~8 ms/step of relayout on the 134M bench otherwise)
-        use_packed = (
-            use_flash
-            and self.attn_mask_type == "causal"
-            and cfg.context_parallel_axis is None
-            and hd % 128 == 0
-        )
+        use_packed = will_pack
 
         def _dropout_seed():
             rng = self.make_rng("dropout")
@@ -272,21 +276,35 @@ class ParallelAttention(nn.Module):
             return jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
 
         if use_packed:
-            if use_flash_dropout:
+            if qkv_bias is None:
+                # use_bias=False projection: the unbiased packed ops
                 from rocm_apex_tpu.ops.flash_attention import (
+                    flash_attention_qkv,
                     flash_attention_qkv_dropout,
                 )
 
-                ctx = flash_attention_qkv_dropout(
-                    qkv, _dropout_seed(), cfg.attention_dropout,
-                    True, scale,
+                if use_flash_dropout:
+                    ctx = flash_attention_qkv_dropout(
+                        qkv, _dropout_seed(), cfg.attention_dropout,
+                        True, scale,
+                    )
+                else:
+                    ctx = flash_attention_qkv(qkv, True, scale)
+            elif use_flash_dropout:
+                from rocm_apex_tpu.ops.flash_attention import (
+                    flash_attention_qkv_bias_dropout,
+                )
+
+                ctx = flash_attention_qkv_bias_dropout(
+                    qkv, qkv_bias, _dropout_seed(),
+                    cfg.attention_dropout, True, scale,
                 )
             else:
                 from rocm_apex_tpu.ops.flash_attention import (
-                    flash_attention_qkv,
+                    flash_attention_qkv_bias,
                 )
 
-                ctx = flash_attention_qkv(qkv, True, scale)
+                ctx = flash_attention_qkv_bias(qkv, qkv_bias, True, scale)
         elif use_flash:
             q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
             qf = q.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
@@ -628,7 +646,10 @@ def _serial_cross_entropy(logits, labels):
     fp32 logits + log-softmax over the vocabulary (the dominant
     non-matmul cost of the LM head)."""
     b, s, v = logits.shape
-    losses = softmax_cross_entropy_loss(
+    # _fused: differentiation emits dlogits during the forward read of
+    # the logits (one pass); the backward is a scalar multiply XLA
+    # fuses into the head's dW/dx matmul prologues
+    losses = softmax_cross_entropy_loss_fused(
         logits.reshape(b * s, v), labels.reshape(b * s), 0.0, None
     )
     return losses.reshape(b, s)

@@ -40,6 +40,8 @@ __all__ = [
     "flash_attention_dropout",
     "flash_attention_qkv",
     "flash_attention_qkv_dropout",
+    "flash_attention_qkv_bias",
+    "flash_attention_qkv_bias_dropout",
 ]
 
 # Large blocks keep the sequential TPU grid short (per-step overhead is
@@ -88,7 +90,10 @@ def _keep_mask(seed_ref, rate, b, qi, ki, shape):
     h = h ^ (h >> 13)
     h = h * jnp.uint32(0xC2B2AE35)
     h = h ^ (h >> 16)
-    pltpu.prng_seed(jax.lax.bitcast_convert_type(h, jnp.int32))
+    # u32 -> s32 convert_element_type is bit-preserving at equal width
+    # (XLA wraps on overflow), and — unlike a scalar bitcast — lowers on
+    # current Mosaic, which rejects 'tpu.bitcast' on non-vector operands
+    pltpu.prng_seed(h.astype(jnp.int32))
     bits = pltpu.prng_random_bits(shape)
     thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return bits.astype(jnp.uint32) >= thresh
@@ -130,9 +135,12 @@ def _masked_scores(
 
 def _fwd_kernel(
     causal, scale, sk_real, block_q, block_k, has_bias, dropout_rate,
-    has_lengths, q_ref, k_ref, v_ref, *refs,
+    has_lengths, q_ref, k_ref, v_ref, *refs, has_qkv_bias=False,
 ):
     refs = list(refs)
+    qb_ref = refs.pop(0) if has_qkv_bias else None
+    kb_ref = refs.pop(0) if has_qkv_bias else None
+    vb_ref = refs.pop(0) if has_qkv_bias else None
     bias_ref = refs.pop(0) if has_bias else None
     seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
     len_ref = refs.pop(0) if has_lengths else None
@@ -152,6 +160,12 @@ def _fwd_kernel(
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
+        if has_qkv_bias:
+            # fused projection bias (same bf16 add the matmul epilogue
+            # would have performed); (1, hd) row broadcasts over block
+            q = q + qb_ref[0]
+            k = k + kb_ref[0]
+            v = v + vb_ref[0]
         s = _masked_scores(
             causal, scale, sk_real, block_q, block_k,
             q, k, bias_ref, len_ref, b, qi, ki,
@@ -656,10 +670,16 @@ def flash_attention(
     mask; ``scale`` defaults to 1/sqrt(head_dim). Differentiable in
     q/k/v AND bias: learned additive biases (ALiBi slopes, relative
     position) train correctly — dbias is computed by a dedicated
-    kernel summing ds over each bias row's head group. Callers whose
-    bias is a constant mask should pass ``compute_dbias=False`` to
-    skip that kernel explicitly (under jit XLA also DCEs it when the
-    bias cotangent is unused).
+    kernel summing ds over each bias row's head group.
+
+    PERFORMANCE NOTE: ``compute_dbias`` defaults to True so learned
+    biases never silently get zero gradients. The dbias kernel
+    materializes an O(bh·sq·sk) fp32 buffer; under jit XLA dead-code-
+    eliminates it whenever the bias cotangent is unused, but an EAGER
+    (non-jit) differentiated call pays for it regardless. Callers whose
+    bias is a constant mask (padding/causal combinations) should pass
+    ``compute_dbias=False`` to skip the kernel and the buffer
+    explicitly.
     """
     o, _ = _fwd(
         q, k, v, bias, causal,
@@ -753,8 +773,52 @@ flash_attention_varlen.defvjp(_fav_fwd, _fav_bwd)
 # no concat appears anywhere in the forward graph.
 
 
+def _fwd_single_kernel(
+    causal, scale, sk_real, block_q, block_k, dropout_rate,
+    q_ref, k_ref, v_ref, *refs, has_qkv_bias=False,
+):
+    """Single-block forward: the online-softmax carry (m/l scratch,
+    correction multiplies, init/finish phases) degenerates when one
+    (block_q, block_k) tile covers the whole sequence — this kernel
+    just computes the row softmax directly. Same masking via
+    `_masked_scores`, same dropout stream as the general kernel."""
+    refs = list(refs)
+    qb_ref = refs.pop(0) if has_qkv_bias else None
+    kb_ref = refs.pop(0) if has_qkv_bias else None
+    vb_ref = refs.pop(0) if has_qkv_bias else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    o_ref, lse_ref = refs
+    b = pl.program_id(0)
+    zero = jnp.int32(0)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    if has_qkv_bias:
+        q = q + qb_ref[0]
+        k = k + kb_ref[0]
+        v = v + vb_ref[0]
+    s = _masked_scores(
+        causal, scale, sk_real, block_q, block_k,
+        q, k, None, None, b, zero, zero,
+    )
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    if dropout_rate > 0.0:
+        keep = _keep_mask(
+            seed_ref, dropout_rate, b, zero, zero, (block_q, block_k)
+        )
+        p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+    safe_l = jnp.where(l > 0.0, l, 1.0)
+    acc = jax.lax.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(safe_l)
+
+
 def _fwd_packed(qkv, causal, scale, block_q, block_k,
-                dropout_rate=0.0, dropout_seed=None):
+                dropout_rate=0.0, dropout_seed=None, qkv_bias=None):
     B, S, nh, three_hd = qkv.shape
     hd = three_hd // 3
     if three_hd != 3 * hd or hd % 128 != 0:
@@ -792,14 +856,57 @@ def _fwd_packed(qkv, causal, scale, block_q, block_k,
             lambda b, i, j: (b // nh, j, (b % nh) * 3 + 2),
         ),
     ]
+    has_qkv_bias = qkv_bias is not None
+    if has_qkv_bias:
+        # middle singleton dim so the (1, hd) tile equals the array's
+        # last-two dims (Mosaic block divisibility rule)
+        b2 = qkv_bias.reshape(nh * 3, 1, hd)
+        ins += [b2, b2, b2]
+        in_specs += [
+            pl.BlockSpec((1, 1, hd), lambda b, i, j: ((b % nh) * 3, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, hd), lambda b, i, j: ((b % nh) * 3 + 1, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, hd), lambda b, i, j: ((b % nh) * 3 + 2, 0, 0)
+            ),
+        ]
     if dropout_rate > 0.0:
         ins.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
+    out_shape = [
+        jax.ShapeDtypeStruct((B, sq_p, nh * hd), qkv.dtype),
+        jax.ShapeDtypeStruct((B * nh, sq_p, 1), jnp.float32),
+    ]
+    if sq_p == block_q and sk_p == block_k and block_q == block_k:
+        # one tile covers the sequence: direct softmax, no online carry
+        def _one_d(spec):
+            # re-key the 3-d (b, i, j) index maps to the 1-d (b,) grid
+            if spec.index_map is None:  # the SMEM seed spec
+                return spec
+            f = spec.index_map
+            return pl.BlockSpec(spec.block_shape, lambda b, f=f: f(b, 0, 0))
+
+        o, lse = pallas_call(
+            functools.partial(
+                _fwd_single_kernel, causal, scale, S, block_q, block_k,
+                dropout_rate, has_qkv_bias=has_qkv_bias,
+            ),
+            grid=(B * nh,),
+            in_specs=[_one_d(spec) for spec in in_specs],
+            out_specs=[
+                pl.BlockSpec((1, block_q, hd), lambda b: (b // nh, 0, b % nh)),
+                pl.BlockSpec((1, block_q, 1), lambda b: (b, 0, 0)),
+            ],
+            out_shape=out_shape,
+        )(*ins)
+        return o[:, :S], lse[:, :S]
+
     o, lse = pallas_call(
         functools.partial(
             _fwd_kernel, causal, scale, S, block_q, block_k, False,
-            dropout_rate, False,
+            dropout_rate, False, has_qkv_bias=has_qkv_bias,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -809,10 +916,7 @@ def _fwd_packed(qkv, causal, scale, block_q, block_k,
             ),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, sq_p, nh * hd), qkv.dtype),
-            jax.ShapeDtypeStruct((B * nh, sq_p, 1), jnp.float32),
-        ],
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -822,8 +926,174 @@ def _fwd_packed(qkv, causal, scale, block_q, block_k,
     return o[:, :S], lse[:, :S]
 
 
+def _bwd_merged_kernel(
+    causal, scale, sk_real, block_q, block_k, hd, dropout_rate,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, *refs,
+    has_qkv_bias=False,
+):
+    """Single-block fused backward: dq + dk + dv in ONE kernel pass.
+
+    Used when one (block_q, block_k) tile covers the whole sequence
+    (the common training regime, e.g. s=1024 blocks 1024²). The split
+    dkv/dq kernels each recompute the score and dp matrices and each
+    re-read q/k/v/do from HBM — 7 MXU matmuls and 2x input traffic.
+    This kernel shares those intermediates (5 matmuls, one read) and
+    writes the three cotangents STRAIGHT INTO the packed projection
+    layout: dqkv_ref is the (1, block, 3*hd) per-head column of the
+    (B, S, nh*3*hd) qkv-projection cotangent, so the 3-way concat the
+    split path needs disappears entirely. delta = rowsum(do·o) is also
+    computed here from the o tile (a few VPU ops on data already in
+    VMEM) instead of as a separate XLA reduction pass over the full
+    (B, S, nh, hd) product in HBM."""
+    refs = list(refs)
+    qb_ref = refs.pop(0) if has_qkv_bias else None
+    kb_ref = refs.pop(0) if has_qkv_bias else None
+    vb_ref = refs.pop(0) if has_qkv_bias else None
+    seed_ref = refs.pop(0) if dropout_rate > 0.0 else None
+    if has_qkv_bias:
+        dqkv_ref, dbias_ref = refs
+    else:
+        (dqkv_ref,) = refs
+    b = pl.program_id(0)
+    zero = jnp.int32(0)  # qi = ki = 0: the single block
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    if has_qkv_bias:
+        # the saved residual is the PRE-bias projection output; the
+        # probability recompute needs the biased operands
+        q = q + qb_ref[0]
+        k = k + kb_ref[0]
+        v = v + vb_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+    s = _masked_scores(
+        causal, scale, sk_real, block_q, block_k,
+        q, k, None, None, b, zero, zero,
+    )
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if dropout_rate > 0.0:
+        keep = _keep_mask(
+            seed_ref, dropout_rate, b, zero, zero, (block_q, block_k)
+        )
+        inv = 1.0 / (1.0 - dropout_rate)
+        p_drop = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    else:
+        p_drop = p
+    dv = jax.lax.dot_general(
+        p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dk = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dq = jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+    dqkv_ref[0, :, :hd] = dq.astype(dqkv_ref.dtype)
+    dqkv_ref[0, :, hd:2 * hd] = dk.astype(dqkv_ref.dtype)
+    dqkv_ref[0, :, 2 * hd:] = dv.astype(dqkv_ref.dtype)
+    if has_qkv_bias:
+        # fp32 per-(batch, head) bias-grad partials while the cotangent
+        # tiles are still in VMEM — replaces a full XLA reduction pass
+        # over the (B, S, nh, 3hd) dqkv buffer in HBM (whose producer is
+        # this opaque kernel, so XLA cannot fuse it)
+        dbias_ref[0, 0, :hd] = jnp.sum(dq, axis=0)
+        dbias_ref[0, 0, hd:2 * hd] = jnp.sum(dk, axis=0)
+        dbias_ref[0, 0, 2 * hd:] = jnp.sum(dv, axis=0)
+
+
+def _bwd_packed_merged(causal, scale, block, res, do,
+                       dropout_rate=0.0, dropout_seed=None,
+                       qkv_bias=None):
+    """Single-tile packed backward: see `_bwd_merged_kernel`.
+
+    With ``qkv_bias`` also returns the (nh*3*hd,) fp32 bias cotangent
+    (summed over batch from the kernel's per-(batch, head) partials)."""
+    qkv, o, lse = res
+    B, S, nh, three_hd = qkv.shape
+    hd = three_hd // 3
+    pad = block
+
+    qkv_p = jnp.pad(
+        qkv.reshape(B, S, nh * three_hd), ((0, 0), (0, pad - S), (0, 0))
+    )
+    do_p = jnp.pad(do, ((0, 0), (0, pad - S), (0, 0)))
+    o_p = jnp.pad(o, ((0, 0), (0, pad - S), (0, 0)))
+    lse_p = jnp.pad(
+        lse, ((0, 0), (0, pad - S), (0, 0)), constant_values=-NEG_INF
+    )
+
+    ins = [qkv_p, qkv_p, qkv_p, do_p, lse_p, o_p]
+    in_specs = [
+        pl.BlockSpec((1, block, hd), lambda b: (b // nh, 0, (b % nh) * 3)),
+        pl.BlockSpec(
+            (1, block, hd), lambda b: (b // nh, 0, (b % nh) * 3 + 1)
+        ),
+        pl.BlockSpec(
+            (1, block, hd), lambda b: (b // nh, 0, (b % nh) * 3 + 2)
+        ),
+        pl.BlockSpec((1, block, hd), lambda b: (b // nh, 0, b % nh)),
+        pl.BlockSpec((1, block, 1), lambda b: (b, 0, 0)),
+        pl.BlockSpec((1, block, hd), lambda b: (b // nh, 0, b % nh)),
+    ]
+    has_qkv_bias = qkv_bias is not None
+    if has_qkv_bias:
+        b2 = qkv_bias.reshape(nh * 3, 1, hd)
+        ins += [b2, b2, b2]
+        in_specs += [
+            pl.BlockSpec((1, 1, hd), lambda b: ((b % nh) * 3, 0, 0)),
+            pl.BlockSpec((1, 1, hd), lambda b: ((b % nh) * 3 + 1, 0, 0)),
+            pl.BlockSpec((1, 1, hd), lambda b: ((b % nh) * 3 + 2, 0, 0)),
+        ]
+    if dropout_rate > 0.0:
+        ins.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+
+    out_specs = pl.BlockSpec(
+        (1, block, three_hd), lambda b: (b // nh, 0, b % nh)
+    )
+    out_shape = jax.ShapeDtypeStruct((B, pad, nh * three_hd), qkv.dtype)
+    if has_qkv_bias:
+        out_specs = [
+            out_specs,
+            pl.BlockSpec((1, 1, three_hd), lambda b: (b, 0, 0)),
+        ]
+        out_shape = [
+            out_shape,
+            jax.ShapeDtypeStruct((B * nh, 1, three_hd), jnp.float32),
+        ]
+
+    out = pallas_call(
+        functools.partial(
+            _bwd_merged_kernel, causal, scale, S, block, block, hd,
+            dropout_rate, has_qkv_bias=has_qkv_bias,
+        ),
+        grid=(B * nh,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+    )(*ins)
+    if has_qkv_bias:
+        dqkv, dbias_part = out
+        dbias = jnp.sum(
+            dbias_part.reshape(B, nh * three_hd), axis=0
+        )
+        return dqkv[:, :S].reshape(B, S, nh, three_hd), dbias
+    return out[:, :S].reshape(B, S, nh, three_hd)
+
+
 def _bwd_packed(causal, scale, block_q, block_k, res, do,
-                dropout_rate=0.0, dropout_seed=None):
+                dropout_rate=0.0, dropout_seed=None, qkv_bias=None):
     qkv, o, lse = res  # qkv (B,S,nh,3hd), o (B,S,nh*hd), lse (B*nh,S,1)
     B, S, nh, three_hd = qkv.shape
     hd = three_hd // 3
@@ -831,6 +1101,24 @@ def _bwd_packed(causal, scale, block_q, block_k, res, do,
     block_k = min(block_k, _round_up(S, 128))
     sq_p = _round_up(S, block_q)
     sk_p = _round_up(S, block_k)
+    if sq_p == block_q and sk_p == block_k and block_q == block_k:
+        # one tile covers the sequence: fused dq+dk+dv kernel, no concat
+        return _bwd_packed_merged(
+            causal, scale, block_q, res, do,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+            qkv_bias=qkv_bias,
+        )
+    if qkv_bias is not None:
+        # multi-tile fallback: biased operands via the pre-add (the
+        # kernels then see the same values), dbias via an XLA reduce
+        qkv = qkv + qkv_bias.reshape(nh, three_hd).astype(qkv.dtype)
+        dqkv = _bwd_packed(
+            causal, scale, block_q, block_k, (qkv, o, lse), do,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        )
+        return dqkv, jnp.sum(
+            dqkv.astype(jnp.float32), axis=(0, 1)
+        ).reshape(-1)
     pad = max(sq_p, sk_p)
 
     # delta rows are keyed by flat (B*nh) like lse: (B,S,nh) -> (B*nh,S,1)
@@ -1022,6 +1310,101 @@ def _faqd_bwd(dropout_rate, causal, scale, block_q, block_k, res, do):
 
 
 flash_attention_qkv_dropout.defvjp(_faqd_fwd, _faqd_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def flash_attention_qkv_bias(
+    qkv: jnp.ndarray,
+    qkv_bias: jnp.ndarray,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jnp.ndarray:
+    """`flash_attention_qkv` with the QKV-projection BIAS fused in.
+
+    ``qkv`` is the bias-free fused projection output (B, S, nh, 3*hd)
+    (e.g. from `ColumnParallelLinear(skip_bias_add=True)`) and
+    ``qkv_bias`` its (nh*3*hd,) bias. The add happens on tile load (the
+    same bf16 add a matmul epilogue performs) and — the actual point —
+    the backward emits fp32 bias-grad partials from VMEM, replacing the
+    full-buffer XLA reduction over dqkv that cannot fuse with this
+    kernel's opaque output. The reference fuses qkv biases into its
+    attention kernels the same way
+    (apex/contrib/csrc/multihead_attn/ *_bias variants)."""
+    o, _ = _fwd_packed(
+        qkv, causal, _qkv_scale(qkv, scale), block_q, block_k,
+        qkv_bias=qkv_bias,
+    )
+    return o
+
+
+def _faqb_fwd(qkv, qkv_bias, causal, scale, block_q, block_k):
+    o, lse = _fwd_packed(
+        qkv, causal, _qkv_scale(qkv, scale), block_q, block_k,
+        qkv_bias=qkv_bias,
+    )
+    return o, (qkv, qkv_bias, o, lse)
+
+
+def _faqb_bwd(causal, scale, block_q, block_k, res, do):
+    qkv, qkv_bias, o, lse = res
+    dqkv, dbias = _bwd_packed(
+        causal, _qkv_scale(qkv, scale), block_q, block_k,
+        (qkv, o, lse), do, qkv_bias=qkv_bias,
+    )
+    return (dqkv, dbias.astype(qkv_bias.dtype).reshape(qkv_bias.shape))
+
+
+flash_attention_qkv_bias.defvjp(_faqb_fwd, _faqb_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_qkv_bias_dropout(
+    qkv: jnp.ndarray,
+    qkv_bias: jnp.ndarray,
+    dropout_seed,
+    dropout_rate: float,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jnp.ndarray:
+    """`flash_attention_qkv_bias` with in-kernel attention dropout."""
+    o, _ = _fwd_packed(
+        qkv, causal, _qkv_scale(qkv, scale), block_q, block_k,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        qkv_bias=qkv_bias,
+    )
+    return o
+
+
+def _faqbd_fwd(qkv, qkv_bias, dropout_seed, dropout_rate, causal, scale,
+               block_q, block_k):
+    o, lse = _fwd_packed(
+        qkv, causal, _qkv_scale(qkv, scale), block_q, block_k,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        qkv_bias=qkv_bias,
+    )
+    return o, (qkv, qkv_bias, o, lse, dropout_seed)
+
+
+def _faqbd_bwd(dropout_rate, causal, scale, block_q, block_k, res, do):
+    qkv, qkv_bias, o, lse, seed = res
+    dqkv, dbias = _bwd_packed(
+        causal, _qkv_scale(qkv, scale), block_q, block_k,
+        (qkv, o, lse), do,
+        dropout_rate=dropout_rate, dropout_seed=seed, qkv_bias=qkv_bias,
+    )
+    seed_ct = np.zeros((), jax.dtypes.float0)
+    return (
+        dqkv,
+        dbias.astype(qkv_bias.dtype).reshape(qkv_bias.shape),
+        seed_ct,
+    )
+
+
+flash_attention_qkv_bias_dropout.defvjp(_faqbd_fwd, _faqbd_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))

@@ -23,7 +23,7 @@ from jax.experimental import pallas as pl
 from rocm_apex_tpu.ops._pallas import kernel_dtype, pallas_call, row_block
 from rocm_apex_tpu.ops._pallas import pad_rows as _pad_rows
 
-__all__ = ["softmax_cross_entropy_loss"]
+__all__ = ["softmax_cross_entropy_loss", "softmax_cross_entropy_loss_fused"]
 
 
 def _block_rows(vocab: int) -> int:
@@ -132,3 +132,95 @@ def _vjp_bwd(smoothing, padding_idx, res, dloss):
 
 
 softmax_cross_entropy_loss.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# one-pass training variant
+# ---------------------------------------------------------------------------
+
+
+def _fwd_dg_kernel(smoothing, x_ref, lbl_ref, loss_ref, dg_ref):
+    """Forward that also emits dg = softmax - target (the UNscaled
+    dlogits) while the logits tile is in VMEM. The backward is then a
+    per-row scalar multiply dg * dloss — produced by XLA, so it fuses
+    into the prologues of the matmuls consuming dlogits. One full read
+    of the logits (the separate backward kernel's re-read) disappears
+    from the train step."""
+    x = x_ref[...].astype(jnp.float32)  # (B, V)
+    lbl = lbl_ref[...]  # (B, 1) int32
+    vocab = x.shape[1]
+    m = jnp.max(x, axis=1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True))
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    xt = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=1, keepdims=True)
+    loss = lse - (1.0 - smoothing) * xt
+    if smoothing > 0.0:
+        loss = loss - (smoothing / vocab) * jnp.sum(x, axis=1, keepdims=True)
+    loss_ref[...] = loss
+    target = jnp.where(col == lbl, 1.0 - smoothing, 0.0) + smoothing / vocab
+    dg_ref[...] = (jnp.exp(x - lse) - target).astype(dg_ref.dtype)
+
+
+def _fwd_dg_impl(logits, labels, smoothing):
+    rows0, vocab = logits.shape
+    block = _block_rows(vocab)
+    xp = _pad_rows(logits, block)
+    lbl = _pad_rows(labels.astype(jnp.int32).reshape(-1, 1), block)
+    rows = xp.shape[0]
+    loss, dg = pallas_call(
+        functools.partial(_fwd_dg_kernel, smoothing),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, vocab), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, vocab), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, vocab), kernel_dtype(logits.dtype)),
+        ],
+    )(xp.astype(kernel_dtype(xp.dtype)), lbl)
+    return loss[:rows0, 0], dg[:rows0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss_fused(
+    logits, labels, smoothing=0.0, padding_idx=0
+):
+    """`softmax_cross_entropy_loss` with a one-pass backward.
+
+    Same values/semantics; differentiation materializes dg =
+    (softmax - target) during the FORWARD pass (one extra (rows, vocab)
+    low-precision write) and the backward is a fused scalar multiply —
+    no second read of the logits. Use in train steps where the logits
+    gradient is always needed; the un-differentiated call is identical
+    to `softmax_cross_entropy_loss` (no dg is written).
+    """
+    loss, _ = _fwd_impl(logits, labels, smoothing)
+    if padding_idx is None:
+        return loss
+    return jnp.where(labels == padding_idx, 0.0, loss)
+
+
+def _vjp_fused_fwd(logits, labels, smoothing, padding_idx):
+    loss, dg = _fwd_dg_impl(logits, labels, smoothing)
+    if padding_idx is not None:
+        loss = jnp.where(labels == padding_idx, 0.0, loss)
+    # zero-size marker carries the primal dtype through the residuals
+    # (a raw dtype object is not a storable JAX type)
+    proto = jnp.zeros((0,), logits.dtype)
+    return loss, (labels, dg, proto)
+
+
+def _vjp_fused_bwd(smoothing, padding_idx, res, dloss):
+    labels, dg, proto = res
+    if padding_idx is not None:
+        dloss = jnp.where(labels == padding_idx, 0.0, dloss)
+    dx = dloss.astype(jnp.float32)[:, None] * dg.astype(jnp.float32)
+    return dx.astype(proto.dtype), None
+
+
+softmax_cross_entropy_loss_fused.defvjp(_vjp_fused_fwd, _vjp_fused_bwd)

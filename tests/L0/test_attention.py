@@ -207,6 +207,51 @@ class TestFMHA:
             np.asarray(g_p), np.asarray(g_u), rtol=1e-5, atol=1e-5
         )
 
+    # (256, None) and (200, None): single-tile merged kernels;
+    # (256, 128): blocks smaller than S exercise the multi-tile
+    # has_qkv_bias forward and the dbias XLA-reduce fallback
+    @pytest.mark.parametrize("S,blk", [(256, None), (200, None), (256, 128)])
+    def test_packed_qkv_bias_matches_preadded(self, S, blk):
+        """flash_attention_qkv_bias (projection bias fused into the
+        kernels, dbias partials emitted in backward) must match the
+        unbiased op on pre-added qkv — values, dqkv, and dbias."""
+        from rocm_apex_tpu.ops.flash_attention import (
+            flash_attention_qkv,
+            flash_attention_qkv_bias,
+        )
+
+        B, nh, hd = 2, 2, 128
+        kq, kb = jax.random.split(jax.random.PRNGKey(17))
+        qkv = jax.random.normal(kq, (B, S, nh, 3 * hd))
+        bias = 0.1 * jax.random.normal(kb, (nh * 3 * hd,))
+        blocks = () if blk is None else (None, blk, blk)
+
+        def fused(qkv, bias):
+            return flash_attention_qkv_bias(qkv, bias, True, *blocks)
+
+        def ref(qkv, bias):
+            return flash_attention_qkv(
+                qkv + bias.reshape(nh, 3 * hd), True
+            )
+
+        np.testing.assert_allclose(
+            np.asarray(fused(qkv, bias)),
+            np.asarray(ref(qkv, bias)),
+            rtol=1e-5, atol=1e-5,
+        )
+        gq, gb = jax.grad(
+            lambda q, b: jnp.sum(fused(q, b) ** 2), (0, 1)
+        )(qkv, bias)
+        gq_r, gb_r = jax.grad(
+            lambda q, b: jnp.sum(ref(q, b) ** 2), (0, 1)
+        )(qkv, bias)
+        np.testing.assert_allclose(
+            np.asarray(gq), np.asarray(gq_r), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(gb), np.asarray(gb_r), rtol=1e-4, atol=1e-4
+        )
+
     def test_packed_qkv_odd_blocks_cover_tail(self):
         """Non-default block sizes that do not divide each other's
         rounding must still process every q row and k column (round-2

@@ -263,6 +263,44 @@ class TestXentropy:
         ref = ref_smoothed_ce(logits, labels, smoothing)
         np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    @pytest.mark.parametrize("padding_idx", [None, 0])
+    def test_fused_variant_matches(self, smoothing, padding_idx):
+        """softmax_cross_entropy_loss_fused (dlogits emitted during the
+        forward read) must match the two-pass op in values AND grads."""
+        from rocm_apex_tpu.ops.xentropy import (
+            softmax_cross_entropy_loss_fused,
+        )
+
+        rows, vocab = 16, 96
+        logits = jax.random.normal(jax.random.PRNGKey(2), (rows, vocab)) * 2
+        labels = jax.random.randint(jax.random.PRNGKey(3), (rows,), 0, vocab)
+        l_f = softmax_cross_entropy_loss_fused(
+            logits, labels, smoothing, padding_idx
+        )
+        l_r = softmax_cross_entropy_loss(logits, labels, smoothing, padding_idx)
+        np.testing.assert_allclose(
+            np.asarray(l_f), np.asarray(l_r), rtol=1e-5, atol=1e-6
+        )
+        w = jax.random.normal(jax.random.PRNGKey(4), (rows,))
+        g_f = jax.grad(
+            lambda l: jnp.sum(
+                w * softmax_cross_entropy_loss_fused(
+                    l, labels, smoothing, padding_idx
+                )
+            )
+        )(logits)
+        g_r = jax.grad(
+            lambda l: jnp.sum(
+                w * softmax_cross_entropy_loss(
+                    l, labels, smoothing, padding_idx
+                )
+            )
+        )(logits)
+        np.testing.assert_allclose(
+            np.asarray(g_f), np.asarray(g_r), rtol=1e-5, atol=1e-6
+        )
+
     def test_padding_idx_zeroes_loss_and_grad(self):
         rows, vocab = 8, 32
         logits = jax.random.normal(jax.random.PRNGKey(0), (rows, vocab))
