@@ -262,7 +262,7 @@ class ParallelAttention(nn.Module):
         # straight out of the fused projection output — no split, no
         # transposes, and the context lands output-projection-ready
         # (measured ~8 ms/step of relayout on the 134M bench otherwise)
-        use_packed = will_pack
+
 
         def _dropout_seed():
             rng = self.make_rng("dropout")
@@ -275,7 +275,7 @@ class ParallelAttention(nn.Module):
                 )
             return jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
 
-        if use_packed:
+        if will_pack:
             if qkv_bias is None:
                 # use_bias=False projection: the unbiased packed ops
                 from rocm_apex_tpu.ops.flash_attention import (

@@ -30,9 +30,10 @@ def _block_rows(vocab: int) -> int:
     return row_block(vocab)
 
 
-def _fwd_kernel(smoothing, x_ref, lbl_ref, loss_ref, lse_ref):
-    x = x_ref[...].astype(jnp.float32)  # (B, V)
-    lbl = lbl_ref[...]  # (B, 1) int32
+def _loss_block(smoothing, x, lbl):
+    """(loss, lse, col) for one fp32 (B, V) tile — the ONE place the
+    loss semantics live; shared by the two-pass forward and the
+    dg-emitting forward so they cannot desynchronize."""
     vocab = x.shape[1]
     m = jnp.max(x, axis=1, keepdims=True)
     lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True))
@@ -41,6 +42,13 @@ def _fwd_kernel(smoothing, x_ref, lbl_ref, loss_ref, lse_ref):
     loss = lse - (1.0 - smoothing) * xt
     if smoothing > 0.0:
         loss = loss - (smoothing / vocab) * jnp.sum(x, axis=1, keepdims=True)
+    return loss, lse, col
+
+
+def _fwd_kernel(smoothing, x_ref, lbl_ref, loss_ref, lse_ref):
+    x = x_ref[...].astype(jnp.float32)  # (B, V)
+    lbl = lbl_ref[...]  # (B, 1) int32
+    loss, lse, _ = _loss_block(smoothing, x, lbl)
     loss_ref[...] = loss
     lse_ref[...] = lse
 
@@ -149,13 +157,7 @@ def _fwd_dg_kernel(smoothing, x_ref, lbl_ref, loss_ref, dg_ref):
     x = x_ref[...].astype(jnp.float32)  # (B, V)
     lbl = lbl_ref[...]  # (B, 1) int32
     vocab = x.shape[1]
-    m = jnp.max(x, axis=1, keepdims=True)
-    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True))
-    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    xt = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=1, keepdims=True)
-    loss = lse - (1.0 - smoothing) * xt
-    if smoothing > 0.0:
-        loss = loss - (smoothing / vocab) * jnp.sum(x, axis=1, keepdims=True)
+    loss, lse, col = _loss_block(smoothing, x, lbl)
     loss_ref[...] = loss
     target = jnp.where(col == lbl, 1.0 - smoothing, 0.0) + smoothing / vocab
     dg_ref[...] = (jnp.exp(x - lse) - target).astype(dg_ref.dtype)

@@ -454,6 +454,83 @@ class TestFlashDropoutTPU:
         )[0]
         np.testing.assert_array_equal(P, P2)
 
+    def test_packed_dropout_grads_match_unpacked(self):
+        """The packed dropout ops (merged single-tile backward) must
+        reproduce the unpacked flash_attention_dropout exactly: the
+        kernels seed per (batch*heads, q-block, k-block), and for a
+        single-tile sequence those coordinates coincide, so the SAME
+        seed must give the SAME mask, values, and gradients."""
+        from rocm_apex_tpu.ops.flash_attention import (
+            flash_attention_dropout,
+            flash_attention_qkv_bias_dropout,
+            flash_attention_qkv_dropout,
+        )
+
+        B, S, nh, hd = 1, 256, 2, 128
+        rate = 0.2
+        seed = jnp.asarray(9, jnp.int32)
+        kq, kb = jax.random.split(jax.random.PRNGKey(7))
+        qkv = (
+            jax.random.normal(kq, (B, S, nh, 3 * hd), jnp.float32) * 0.5
+        )
+        bias = 0.1 * jax.random.normal(kb, (nh * 3 * hd,))
+
+        def unpacked(qkv):
+            q = qkv[..., :hd].transpose(0, 2, 1, 3).reshape(B * nh, S, hd)
+            k = (
+                qkv[..., hd:2 * hd]
+                .transpose(0, 2, 1, 3)
+                .reshape(B * nh, S, hd)
+            )
+            v = (
+                qkv[..., 2 * hd:]
+                .transpose(0, 2, 1, 3)
+                .reshape(B * nh, S, hd)
+            )
+            o = flash_attention_dropout(q, k, v, None, seed, rate, True)
+            return (
+                o.reshape(B, nh, S, hd)
+                .transpose(0, 2, 1, 3)
+                .reshape(B, S, nh * hd)
+            )
+
+        def packed(qkv):
+            return flash_attention_qkv_dropout(qkv, seed, rate, True)
+
+        np.testing.assert_allclose(
+            np.asarray(packed(qkv)), np.asarray(unpacked(qkv)),
+            rtol=1e-5, atol=1e-5,
+        )
+        g_p = jax.grad(lambda x: jnp.sum(packed(x) ** 2))(qkv)
+        g_u = jax.grad(lambda x: jnp.sum(unpacked(x) ** 2))(qkv)
+        np.testing.assert_allclose(
+            np.asarray(g_p), np.asarray(g_u), rtol=2e-4, atol=2e-4
+        )
+
+        # biased + dropout == unbiased dropout on pre-added qkv
+        def biased(qkv, bias):
+            return flash_attention_qkv_bias_dropout(
+                qkv, bias, seed, rate, True
+            )
+
+        pre = qkv + bias.reshape(nh, 3 * hd)
+        np.testing.assert_allclose(
+            np.asarray(biased(qkv, bias)), np.asarray(packed(pre)),
+            rtol=1e-5, atol=1e-5,
+        )
+        gq, gb = jax.grad(
+            lambda x, b: jnp.sum(biased(x, b) ** 2), (0, 1)
+        )(qkv, bias)
+        gq_r = jax.grad(lambda x: jnp.sum(packed(x) ** 2))(pre)
+        np.testing.assert_allclose(
+            np.asarray(gq), np.asarray(gq_r), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(gb),
+            np.asarray(gq_r.astype(jnp.float32).sum((0, 1)).reshape(-1)),
+            rtol=2e-3, atol=2e-3,
+        )
+
     def test_grads_match_masked_reference(self):
         from rocm_apex_tpu.ops.flash_attention import flash_attention_dropout
 
