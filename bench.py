@@ -356,6 +356,65 @@ def bench_attn():
     )
 
 
+def bench_fmha():
+    """Packed-native vs padded-batch varlen attention at high
+    raggedness (the BASELINE.md fmha row; reference design point:
+    apex/contrib/fmha packed kernels). 64 sequences drawn from a
+    long-tailed length mix padding to max_s=2048: the padded path pays
+    b*max_s, the packed path pays O(total)."""
+    import numpy as np
+
+    from rocm_apex_tpu.contrib.fmha import fmha
+
+    on_tpu = jax.default_backend() == "tpu"
+    h, d = 8, 64
+    if on_tpu:
+        rng = np.random.RandomState(0)
+        lens = rng.choice(
+            [64, 128, 256, 512, 2048], size=64, p=[0.3, 0.3, 0.2, 0.15, 0.05]
+        ).tolist()
+        iters = 20
+    else:
+        lens = [32, 64, 8]
+        iters = 2
+    max_s = max(lens)
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    total = int(cu[-1])
+    qkv = 0.5 * jax.random.normal(
+        jax.random.PRNGKey(0), (total, 3, h, d), jnp.bfloat16
+    )
+    print(
+        f"fmha raggedness: b={len(lens)} total={total} "
+        f"b*max_s={len(lens) * max_s}",
+        file=sys.stderr,
+    )
+
+    results = {}
+    for name, packed in (("packed", True), ("padded", False)):
+        def step(carry, packed=packed):
+            x, acc = carry
+
+            def loss(x):
+                return jnp.sum(
+                    fmha(
+                        x, cu, max_s, causal=True, packed=packed
+                    ).astype(jnp.float32) ** 2
+                )
+
+            l, g = jax.value_and_grad(loss)(x)
+            tot = l + jnp.sum(g.astype(jnp.float32))
+            return x + (tot * 1e-30).astype(x.dtype), acc + tot
+
+        results[name] = _timed_scan(step, (qkv, jnp.float32(0)), iters)
+        print(f"fmha {name}: {results[name]:.2f} ms fwd+bwd", file=sys.stderr)
+    _report(
+        "fmha_packed_native_fwd_bwd_ms", results["packed"], "ms",
+        results["padded"] / results["packed"],
+        f"packed {results['packed']:.2f} ms vs padded "
+        f"{results['padded']:.2f} ms (speedup = vs_baseline)",
+    )
+
+
 def bench_optim():
     """Optimizer micro-bench on the 134M-param GPT tree (the BASELINE.md
     optimizer row): parity `fused_adam` (XLA-tree-fused) vs
@@ -553,6 +612,7 @@ if __name__ == "__main__":
         "rn50": bench_rn50,
         "bert": bench_bert,
         "attn": bench_attn,
+        "fmha": bench_fmha,
         "optim": bench_optim,
         "ln": bench_ln,
     }

@@ -5,11 +5,12 @@ Rebuild of the reference FMHA
 packed along the token axis, ``cu_seqlens`` (b+1,) int32 prefix
 offsets, returns ``(total, h, d)``). The reference's hand-tiled kernels
 cap seqlen at 512 with `_nl` variants for small batch
-(apex/contrib/csrc/fmha/); this unpacks into a padded batch, runs the
-Pallas flash kernel with an in-kernel per-row key-length bound
-(`flash_attention_varlen`), and re-packs. The unpack/re-pack are
-gathers XLA fuses around the kernel; no (s, s) score or mask tensor
-ever materializes in HBM.
+(apex/contrib/csrc/fmha/); here the default path is packed-NATIVE
+(`flash_attention_segments`: segment-id masking straight over the
+token stream, O(total) allocations, matching the reference's design
+point), with the padded-batch path (`flash_attention_varlen` with
+in-kernel per-row key bounds) retained behind ``packed=False``. No
+(s, s) score or mask tensor ever materializes in HBM on either path.
 """
 
 from typing import Optional
@@ -19,6 +20,9 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.ops.flash_attention import flash_attention_varlen
+from rocm_apex_tpu.ops.flash_attention_segments import (
+    flash_attention_segments,
+)
 
 __all__ = ["fmha", "FMHA"]
 
@@ -38,16 +42,35 @@ def fmha(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    packed: bool = True,
 ) -> jnp.ndarray:
     """Packed-varlen attention: ``qkv (total, 3, h, d)`` -> ``(total, h, d)``.
 
     `cu_seqlens` is the (b+1,) int32 prefix-sum of sequence lengths and
     `max_s` the static padding length (reference fmha.py:33-56 takes the
     same triple). No 512-token ceiling.
+
+    ``packed=True`` (default) runs the packed-NATIVE kernel
+    (`flash_attention_segments`): attention directly over the token
+    stream with segment-id masking — every allocation O(total), like
+    the reference kernels (apex/contrib/csrc/fmha/fmha_api.cpp:432).
+    ``packed=False`` keeps the padded-batch path (scatter to
+    (b, max_s, …), per-row kv bounds, gather back) whose compute and
+    HBM scale with b·max_s — faster only when lengths are uniform and
+    aligned.
     """
     total, three, h, d = qkv.shape
     assert three == 3, qkv.shape
     b = cu_seqlens.shape[0] - 1
+    if packed:
+        seg, _ = _unpack_ids(cu_seqlens, total, max_s)
+        q = qkv[:, 0].transpose(1, 0, 2)  # (h, total, d)
+        k = qkv[:, 1].transpose(1, 0, 2)
+        v = qkv[:, 2].transpose(1, 0, 2)
+        ctx = flash_attention_segments(
+            q, k, v, seg.astype(jnp.int32), causal, scale
+        )
+        return ctx.transpose(1, 0, 2)  # (total, h, d)
     seq_id, offset = _unpack_ids(cu_seqlens, total, max_s)
 
     # scatter packed tokens into the padded (b, max_s, 3, h, d) batch

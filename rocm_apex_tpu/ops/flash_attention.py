@@ -101,7 +101,7 @@ def _keep_mask(seed_ref, rate, b, qi, ki, shape):
 
 def _masked_scores(
     causal, scale, sk_real, block_q, block_k,
-    q, k, bias_ref, len_ref, b, qi, ki,
+    q, k, bias_ref, len_ref, b, qi, ki, seg=None,
 ):
     """The masked fp32 score block for grid point (b, qi, ki) — shared
     by ALL FOUR kernels (fwd, dkv, dq, dbias). Masking semantics live
@@ -125,6 +125,13 @@ def _masked_scores(
         # per-row real key length (varlen): in-kernel bound, the
         # flash-grade replacement for a materialized (s, s) mask
         s = jnp.where(col < len_ref[b], s, NEG_INF)
+    if seg is not None:
+        # packed-stream segment masking: token i attends token j only
+        # within the same segment (flash_attention_segments)
+        sq_ids, sk_ids = seg
+        s = jnp.where(
+            sq_ids[...] == sk_ids[...].reshape(1, -1), s, NEG_INF
+        )
     if causal:
         row = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
