@@ -1,0 +1,78 @@
+"""Doc build step: verify every documented symbol exists.
+
+There is no Sphinx in this toolchain, so the docs are Markdown and the
+"build" is a staleness check: every page ends with a fenced
+```coverage`` block of ``module: sym, sym, ...`` lines; this script
+imports each module and getattrs each symbol. A rename or removal in
+the package breaks the doc build, exactly like a Sphinx autodoc
+failure would.
+
+Run: ``python docs/build.py`` (exit 0 = docs build).
+"""
+
+import importlib
+import pathlib
+import re
+import sys
+
+DOCS = pathlib.Path(__file__).resolve().parent
+# the script dir is docs/ — put the repo root on sys.path so the
+# package imports without installation
+sys.path.insert(0, str(DOCS.parent))
+# anchored to line start: indented illustrative examples in prose
+# (index.md) must not parse as live coverage
+BLOCK = re.compile(r"^```coverage\n(.*?)^```", re.S | re.M)
+
+
+def coverage_entries():
+    for page in sorted(DOCS.glob("*.md")):
+        text = page.read_text()
+        for block in BLOCK.findall(text):
+            for line in block.strip().splitlines():
+                if not line.strip():
+                    continue
+                mod, _, syms = line.partition(":")
+                yield page.name, mod.strip(), [
+                    s.strip() for s in syms.split(",") if s.strip()
+                ]
+
+
+# pages that are pure navigation/prose and carry no coverage block
+NO_COVERAGE_PAGES = {"index.md"}
+
+
+def main():
+    failures = []
+    n_pages, n_syms = set(), 0
+    for page, modname, syms in coverage_entries():
+        n_pages.add(page)
+        try:
+            mod = importlib.import_module(modname)
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            failures.append(f"{page}: cannot import {modname}: {e}")
+            continue
+        for sym in syms:
+            n_syms += 1
+            if not hasattr(mod, sym):
+                failures.append(f"{page}: {modname}.{sym} does not exist")
+    # a malformed fence (stray space, CRLF) silently yields zero
+    # entries — treat a coverage-less page as a build failure
+    for page in sorted(DOCS.glob("*.md")):
+        if page.name not in NO_COVERAGE_PAGES and page.name not in n_pages:
+            failures.append(
+                f"{page.name}: no parseable ```coverage block "
+                "(malformed fence?)"
+            )
+    if failures:
+        print("DOC BUILD FAILED:")
+        for f in failures:
+            print("  " + f)
+        return 1
+    print(
+        f"docs build OK: {n_syms} symbols across {len(n_pages)} pages verified"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

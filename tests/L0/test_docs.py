@@ -1,0 +1,89 @@
+"""The doc set must build: every documented symbol exists.
+
+The reference ships a Sphinx doc set
+(reference: docs/source/{amp,optimizers,parallel,layernorm,advanced}.rst);
+this repo's docs/ are Markdown with machine-checked coverage blocks —
+`docs/build.py` is the build step and this test runs it, so renaming or
+removing a public symbol breaks CI until the docs follow.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+# every user-facing package of the framework (the README capability
+# table's rows, normalized): each must appear in a machine-verified
+# ```coverage block — prose mentions do not count
+CAPABILITY_PACKAGES = [
+    "amp",
+    "optimizers",
+    "optimizers.mixed",
+    "contrib.optimizers",
+    "normalization",
+    "contrib.layer_norm",
+    "ops.flash_attention",
+    "ops.flash_attention_segments",
+    "contrib.fmha",
+    "contrib.multihead_attn",
+    "parallel",
+    "contrib.groupbn",
+    "transformer.parallel_state",
+    "transformer.tensor_parallel",
+    "transformer.pipeline_parallel",
+    "transformer.amp",
+    "transformer.context_parallel",
+    "transformer.moe",
+    "transformer.testing",
+    "checkpoint",
+    "mlp",
+    "fused_dense",
+    "contrib.xentropy",
+    "contrib.transducer",
+    "contrib.sparsity",
+    "contrib.bottleneck",
+    "models",
+    "fp16_utils",
+    "RNN",
+    "reparameterization",
+    "profiler",
+    "multi_tensor_apply",
+]
+
+
+def test_docs_build():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "docs" / "build.py")],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "docs build OK" in out.stdout
+
+
+def _covered_modules():
+    sys.path.insert(0, str(REPO / "docs"))
+    try:
+        import build as docs_build
+    finally:
+        sys.path.pop(0)
+    return {mod for _, mod, _ in docs_build.coverage_entries()}
+
+
+def test_docs_cover_capability_packages():
+    """Every capability package is in a coverage block (not just
+    mentioned in prose) — deleting its docs section fails here."""
+    covered = _covered_modules()
+    missing = [
+        pkg
+        for pkg in CAPABILITY_PACKAGES
+        if not any(
+            m == f"rocm_apex_tpu.{pkg}"
+            or m.startswith(f"rocm_apex_tpu.{pkg}.")
+            for m in covered
+        )
+    ]
+    assert not missing, f"capability packages not in coverage: {missing}"
