@@ -56,37 +56,56 @@ def parse_args():
     return p.parse_args()
 
 
-def main():
-    args = parse_args()
+def build_training(
+    arch="resnet50",
+    opt_level="O5",
+    *,
+    batch_size,
+    image_size,
+    num_classes=1000,
+    loss_scale=None,
+    keep_batchnorm_fp32=None,
+    sync_bn=False,
+    lr=0.1,
+    momentum=0.9,
+    weight_decay=1e-4,
+    seed=0,
+    verbosity=1,
+):
+    """The example's training setup, importable: returns
+    ``(step, state)`` where ``step(*state, x, y) -> (*state, loss)`` is
+    the jitted shard_map train step over the ``data`` mesh axis and
+    ``state = (params, batch_stats, opt_state, scaler_state)``.
+
+    tests/L1/test_determinism_imagenet.py drives the determinism
+    cross-product through THIS function — the real example step, mesh
+    included — mirroring how the reference's L1 harness executes
+    main_amp.py itself (reference: tests/L1/common/run_test.sh:20-27).
+    """
     devices = jax.devices()
     mesh = Mesh(np.array(devices), ("data",))
     dp = len(devices)
-    if args.batch_size % dp:
-        raise SystemExit(f"batch size {args.batch_size} not divisible by {dp}")
+    if batch_size % dp:
+        raise ValueError(f"batch size {batch_size} not divisible by {dp}")
 
-    model = getattr(models, args.arch)(
-        num_classes=args.num_classes,
-        sync_bn_axis="data" if args.sync_bn else None,
+    model = getattr(models, arch)(
+        num_classes=num_classes,
+        sync_bn_axis="data" if sync_bn else None,
     )
 
-    x0 = jnp.zeros(
-        (args.batch_size // dp, args.image_size, args.image_size, 3)
-    )
-    variables = model.init(jax.random.PRNGKey(0), x0)
+    x0 = jnp.zeros((batch_size // dp, image_size, image_size, 3))
+    variables = model.init(jax.random.PRNGKey(seed), x0)
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
 
     overrides = {}
-    if args.loss_scale is not None:
-        overrides["loss_scale"] = (
-            "dynamic" if args.loss_scale == "dynamic" else float(args.loss_scale)
-        )
-    if args.keep_batchnorm_fp32 is not None:
-        overrides["keep_batchnorm_fp32"] = args.keep_batchnorm_fp32 == "True"
-    optimizer = FusedSGD(
-        args.lr, momentum=args.momentum, weight_decay=args.weight_decay
-    )
+    if loss_scale is not None:
+        overrides["loss_scale"] = loss_scale
+    if keep_batchnorm_fp32 is not None:
+        overrides["keep_batchnorm_fp32"] = keep_batchnorm_fp32
+    optimizer = FusedSGD(lr, momentum=momentum, weight_decay=weight_decay)
     params, optimizer, amp_state = amp.initialize(
-        params, optimizer, opt_level=args.opt_level, **overrides
+        params, optimizer, opt_level=opt_level, verbosity=verbosity,
+        **overrides
     )
     opt_state = optimizer.init(params)
     scaler_state = amp_state.scaler_states
@@ -124,7 +143,33 @@ def main():
         out_specs=(P(), P(), P(), P(), P()),
         check_rep=False,
     )
-    step = jax.jit(step)
+    return jax.jit(step), (params, batch_stats, opt_state, scaler_state)
+
+
+def main():
+    args = parse_args()
+
+    loss_scale = None
+    if args.loss_scale is not None:
+        loss_scale = (
+            "dynamic" if args.loss_scale == "dynamic" else float(args.loss_scale)
+        )
+    keep_bn = None
+    if args.keep_batchnorm_fp32 is not None:
+        keep_bn = args.keep_batchnorm_fp32 == "True"
+    step, (params, batch_stats, opt_state, scaler_state) = build_training(
+        args.arch,
+        args.opt_level,
+        batch_size=args.batch_size,
+        image_size=args.image_size,
+        num_classes=args.num_classes,
+        loss_scale=loss_scale,
+        keep_batchnorm_fp32=keep_bn,
+        sync_bn=args.sync_bn,
+        lr=args.lr,
+        momentum=args.momentum,
+        weight_decay=args.weight_decay,
+    )
 
     def batches(rng):
         """Synthetic stand-in for the DataLoader + fast_collate pipeline

@@ -31,24 +31,29 @@ def _block_rows(vocab: int) -> int:
 
 
 def _loss_block(smoothing, x, lbl):
-    """(loss, lse, col) for one fp32 (B, V) tile — the ONE place the
-    loss semantics live; shared by the two-pass forward and the
-    dg-emitting forward so they cannot desynchronize."""
+    """(loss, lse, col, p, ssum) for one fp32 (B, V) tile — the ONE
+    place the loss semantics live; shared by the two-pass forward and
+    the dg-emitting forward so they cannot desynchronize. ``p`` is the
+    unnormalized exp(x - rowmax) and ``ssum`` its row sum: callers that
+    need the softmax reuse them (exp(x - lse) == p / ssum) instead of
+    paying a second full-width exp."""
     vocab = x.shape[1]
     m = jnp.max(x, axis=1, keepdims=True)
-    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True))
+    p = jnp.exp(x - m)
+    ssum = jnp.sum(p, axis=1, keepdims=True)
+    lse = m + jnp.log(ssum)
     col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     xt = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=1, keepdims=True)
     loss = lse - (1.0 - smoothing) * xt
     if smoothing > 0.0:
         loss = loss - (smoothing / vocab) * jnp.sum(x, axis=1, keepdims=True)
-    return loss, lse, col
+    return loss, lse, col, p, ssum
 
 
 def _fwd_kernel(smoothing, x_ref, lbl_ref, loss_ref, lse_ref):
     x = x_ref[...].astype(jnp.float32)  # (B, V)
     lbl = lbl_ref[...]  # (B, 1) int32
-    loss, lse, _ = _loss_block(smoothing, x, lbl)
+    loss, lse, _, _, _ = _loss_block(smoothing, x, lbl)
     loss_ref[...] = loss
     lse_ref[...] = lse
 
@@ -157,10 +162,13 @@ def _fwd_dg_kernel(smoothing, x_ref, lbl_ref, loss_ref, dg_ref):
     x = x_ref[...].astype(jnp.float32)  # (B, V)
     lbl = lbl_ref[...]  # (B, 1) int32
     vocab = x.shape[1]
-    loss, lse, col = _loss_block(smoothing, x, lbl)
+    # one exp pass serves both outputs: exp(x - lse) == p / ssum, so
+    # dg reuses the p computed for the normalizer inside _loss_block
+    # (the naive form pays a second full-width exp)
+    loss, _, col, p, ssum = _loss_block(smoothing, x, lbl)
     loss_ref[...] = loss
     target = jnp.where(col == lbl, 1.0 - smoothing, 0.0) + smoothing / vocab
-    dg_ref[...] = (jnp.exp(x - lse) - target).astype(dg_ref.dtype)
+    dg_ref[...] = (p * (1.0 / ssum) - target).astype(dg_ref.dtype)
 
 
 def _fwd_dg_impl(logits, labels, smoothing):

@@ -1,0 +1,12 @@
+"""Shared helpers for the L0 test files (pytest puts this dir on
+sys.path, so plain `from _helpers import ...` works without a package)."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+
+def jit_shmap(*args, **kwargs):
+    """jit-wrapped shard_map: eager shard_map dispatches per-op on the
+    CPU mesh and runs Pallas kernels in slow python-interpret mode —
+    half the old suite runtime was exactly this."""
+    return jax.jit(shard_map(*args, **kwargs))

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.experimental.shard_map import shard_map
+
+from _helpers import jit_shmap as _jit_shmap
 from jax.sharding import Mesh, PartitionSpec as P
 
 from rocm_apex_tpu.ops.flash_attention import flash_attention
@@ -42,7 +44,7 @@ class TestRingAttention:
         bh, s, d = 2, 512, 64
         q, k, v = make_qkv(jax.random.PRNGKey(0), bh, s, d)
 
-        ring = shard_map(
+        ring = _jit_shmap(
             lambda q, k, v: ring_flash_attention(
                 q, k, v, "context", causal
             ),
@@ -63,7 +65,7 @@ class TestRingAttention:
         q, k, v = make_qkv(jax.random.PRNGKey(1), bh, s, d)
 
         def ring_loss(q, k, v):
-            f = shard_map(
+            f = _jit_shmap(
                 lambda q, k, v: ring_flash_attention(q, k, v, "context", True),
                 mesh=mesh,
                 in_specs=(P(None, "context"),) * 3,
@@ -95,7 +97,7 @@ class TestUlyssesAttention:
         k = jax.random.normal(kk, (b, s, h, d))
         v = jax.random.normal(kv, (b, s, h, d))
 
-        uly = shard_map(
+        uly = _jit_shmap(
             lambda q, k, v: ulysses_attention(q, k, v, "context", causal),
             mesh=mesh,
             in_specs=(P(None, "context"),) * 3,
@@ -160,7 +162,7 @@ class TestGPTContextParallel:
 
         want = model_ref.apply(params, tokens)
 
-        f = shard_map(
+        f = _jit_shmap(
             lambda p, t: model_cp.apply(p, t),
             mesh=mesh,
             in_specs=(P(), P(None, "context")),

@@ -11,6 +11,8 @@ import numpy as np
 import optax
 import pytest
 from jax.experimental.shard_map import shard_map
+
+from _helpers import jit_shmap as _jit_shmap
 from jax.sharding import Mesh, PartitionSpec as P
 
 from rocm_apex_tpu.contrib.bottleneck import Bottleneck, SpatialBottleneck
@@ -91,7 +93,7 @@ class TestGroupBN:
             y, _ = m.apply(variables, x, mutable=["batch_stats"])
             return y
 
-        f = shard_map(
+        f = _jit_shmap(
             local, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
             check_rep=False,
         )
@@ -313,7 +315,7 @@ class TestBottleneck:
             return spatial.apply(variables, x_shard, train=False)
 
         # shard H (axis 1) over the spatial axis
-        f = shard_map(
+        f = _jit_shmap(
             local, mesh=mesh,
             in_specs=(P(None, "spatial"),),
             out_specs=P(None, "spatial"),

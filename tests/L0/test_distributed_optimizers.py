@@ -15,6 +15,8 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from _helpers import jit_shmap as _jit_shmap
+
 from rocm_apex_tpu.contrib.optimizers import (
     distributed_fused_adam,
     distributed_fused_lamb,
@@ -68,7 +70,7 @@ def run_sharded(tx, params, stacked_grads, mesh, steps=3):
             params = optax.apply_updates(params, updates)
         return params
 
-    f = shard_map(
+    f = _jit_shmap(
         local,
         mesh=mesh,
         in_specs=(P(), P("data")),

@@ -9,6 +9,7 @@ must fall) on single device and the CPU mesh.
 import jax
 import jax.numpy as jnp
 import numpy as np
+from functools import partial
 import optax
 import pytest
 
@@ -40,8 +41,10 @@ class TestResNet:
     def test_forward_shapes(self):
         m = resnet18(num_classes=10)
         x = jnp.ones((2, 64, 64, 3))
-        variables = m.init(jax.random.PRNGKey(0), x, train=False)
-        y = m.apply(variables, x, train=False)
+        variables = jax.jit(partial(m.init, train=False))(
+            jax.random.PRNGKey(0), x
+        )
+        y = jax.jit(partial(m.apply, train=False))(variables, x)
         assert y.shape == (2, 10)
 
     def test_train_step_reduces_loss(self):
@@ -99,10 +102,10 @@ class TestResNet:
             y, _ = m.apply(variables, x, mutable=["batch_stats"])
             return y
 
-        f = shard_map(
+        f = jax.jit(shard_map(
             local, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
             check_rep=False,
-        )
+        ))
         y = f(x)
         assert y.shape == (4, 4)
 
@@ -111,11 +114,11 @@ class TestDCGAN:
     def test_generator_discriminator_shapes(self):
         g, d = Generator(), Discriminator()
         z = jax.random.normal(jax.random.PRNGKey(5), (2, 1, 1, 100))
-        gv = g.init(jax.random.PRNGKey(6), z, train=False)
-        img = g.apply(gv, z, train=False)
+        gv = jax.jit(partial(g.init, train=False))(jax.random.PRNGKey(6), z)
+        img = jax.jit(partial(g.apply, train=False))(gv, z)
         assert img.shape == (2, 64, 64, 3)
-        dv = d.init(jax.random.PRNGKey(7), img, train=False)
-        logit = d.apply(dv, img, train=False)
+        dv = jax.jit(partial(d.init, train=False))(jax.random.PRNGKey(7), img)
+        logit = jax.jit(partial(d.apply, train=False))(dv, img)
         assert logit.shape == (2, 1)
 
 
@@ -151,14 +154,16 @@ class TestGPT:
                 x = layer.apply(sub, x)
             return x
 
+        chained = jax.jit(chained)
+        eager = jax.jit(eager)
         y_c = chained(params, x)
         y_e = eager(params, x)
         np.testing.assert_allclose(
             np.asarray(y_c, np.float32), np.asarray(y_e, np.float32),
             rtol=1e-5, atol=1e-5,
         )
-        g_c = jax.grad(lambda p: jnp.sum(chained(p, x) ** 2))(params)
-        g_e = jax.grad(lambda p: jnp.sum(eager(p, x) ** 2))(params)
+        g_c = jax.jit(jax.grad(lambda p: jnp.sum(chained(p, x) ** 2)))(params)
+        g_e = jax.jit(jax.grad(lambda p: jnp.sum(eager(p, x) ** 2)))(params)
         for a, b in zip(
             jax.tree_util.tree_leaves(g_c), jax.tree_util.tree_leaves(g_e)
         ):
@@ -220,10 +225,10 @@ class TestBERT:
         model = BertModel(cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(12), (2, 16), 0, 128)
         mask = jnp.ones((2, 16), jnp.int32).at[1, 10:].set(0)
-        params = model.init(jax.random.PRNGKey(13), tokens, mask)
-        logits, binary = model.apply(params, tokens, mask)
+        params = jax.jit(model.init)(jax.random.PRNGKey(13), tokens, mask)
+        logits, binary = jax.jit(model.apply)(params, tokens, mask)
         assert logits.shape == (2, 16, 128)
         assert binary.shape == (2, 2)
-        losses, _ = model.apply(params, tokens, mask, lm_labels=tokens)
+        losses, _ = jax.jit(model.apply)(params, tokens, mask, lm_labels=tokens)
         assert losses.shape == (2, 16)
         assert np.isfinite(np.asarray(losses)).all()

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.experimental.shard_map import shard_map
+
+from _helpers import jit_shmap as _jit_shmap
 from jax.sharding import Mesh, PartitionSpec as P
 
 from rocm_apex_tpu.transformer.moe import SwitchMLP, switch_route
@@ -105,7 +107,7 @@ class TestSwitchMLP:
             )
             return m.apply(params, x)
 
-        f_ep = shard_map(
+        f_ep = _jit_shmap(
             local2, mesh=mesh,
             in_specs=(
                 {"params": {

@@ -15,6 +15,8 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from _helpers import jit_shmap as _jit_shmap
+
 from rocm_apex_tpu.parallel import (
     LARC,
     DistributedDataParallel,
@@ -36,7 +38,7 @@ class TestSyncGradients:
         mesh = data_mesh(eight_devices)
         g = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 3))
 
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: sync_gradients({"w": t}, "data")["w"],
             mesh=mesh,
             in_specs=P("data"),
@@ -49,7 +51,7 @@ class TestSyncGradients:
     def test_sum_when_not_averaging(self, eight_devices):
         mesh = data_mesh(eight_devices)
         g = jax.random.normal(jax.random.PRNGKey(1), (8, 5))
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: sync_gradients(t, "data", gradient_average=False),
             mesh=mesh,
             in_specs=P("data"),
@@ -64,7 +66,7 @@ class TestSyncGradients:
         (reference: distributed.py:443-455)."""
         mesh = data_mesh(eight_devices)
         g = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: sync_gradients(t, "data", gradient_predivide_factor=4.0),
             mesh=mesh,
             in_specs=P("data"),
@@ -75,7 +77,7 @@ class TestSyncGradients:
     def test_allreduce_always_fp32_returns_original_dtype(self, eight_devices):
         mesh = data_mesh(eight_devices)
         g = jax.random.normal(jax.random.PRNGKey(3), (8, 8)).astype(jnp.bfloat16)
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: sync_gradients(t, "data", allreduce_always_fp32=True),
             mesh=mesh,
             in_specs=P("data"),
@@ -95,7 +97,7 @@ class TestSyncGradients:
         mesh = data_mesh(eight_devices)
         groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
         g = jax.random.normal(jax.random.PRNGKey(4), (8, 6))
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: sync_gradients(t, "data", axis_index_groups=groups),
             mesh=mesh,
             in_specs=P("data"),
@@ -110,7 +112,7 @@ class TestSyncGradients:
         ddp = DistributedDataParallel(allreduce_always_fp32=True)
         red = Reducer()
         g = jax.random.normal(jax.random.PRNGKey(5), (8, 4))
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: (ddp(t), red(t)),
             mesh=mesh,
             in_specs=P("data"),
@@ -123,7 +125,7 @@ class TestSyncGradients:
     def test_broadcast_params_restores_agreement(self, eight_devices):
         mesh = data_mesh(eight_devices)
         p = jax.random.normal(jax.random.PRNGKey(6), (8, 3))
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: broadcast_params({"w": t})["w"],
             mesh=mesh,
             in_specs=P("data"),
@@ -136,7 +138,7 @@ class TestSyncGradients:
     def test_int_leaves_pass_through(self, eight_devices):
         mesh = data_mesh(eight_devices)
         step = jnp.arange(8, dtype=jnp.int32)
-        f = shard_map(
+        f = _jit_shmap(
             lambda t: sync_gradients(t, "data"),
             mesh=mesh,
             in_specs=P("data"),
@@ -169,7 +171,7 @@ class TestSyncBatchNorm:
             )
             return y, upd["batch_stats"]
 
-        f = shard_map(
+        f = _jit_shmap(
             step, mesh=mesh, in_specs=P("data"), out_specs=(P("data"), P())
         )
         y, stats = f(x)
@@ -189,7 +191,7 @@ class TestSyncBatchNorm:
         x = jax.random.normal(jax.random.PRNGKey(2), (16, 4, 3, 5))  # NCHW
         bn = SyncBatchNorm(channel_last=False, axis_name="data")
         vars_ = bn.init(jax.random.PRNGKey(1), x[:2], use_running_average=False)
-        f = shard_map(
+        f = _jit_shmap(
             lambda xs: bn.apply(vars_, xs, use_running_average=False),
             mesh=mesh,
             in_specs=P("data"),
@@ -210,7 +212,7 @@ class TestSyncBatchNorm:
             channel_last=True, axis_name="data", axis_index_groups=groups
         )
         vars_ = bn.init(jax.random.PRNGKey(1), x[:2], use_running_average=False)
-        f = shard_map(
+        f = _jit_shmap(
             lambda xs: bn.apply(vars_, xs, use_running_average=False),
             mesh=mesh,
             in_specs=P("data"),
@@ -253,7 +255,7 @@ class TestSyncBatchNorm:
                 y = bn.apply(vars_, xl, use_running_average=False)
                 return jax.lax.psum(jnp.sum(y**2), "data")
 
-            f = shard_map(local, mesh=mesh, in_specs=P("data"), out_specs=P())
+            f = _jit_shmap(local, mesh=mesh, in_specs=P("data"), out_specs=P())
             return f(xs)
 
         def full_loss(xs):
@@ -387,7 +389,7 @@ class TestReplicaConsistency:
             # emit THIS RANK's replica for cross-rank comparison
             return jax.tree_util.tree_map(lambda v: v[None], params)
 
-        f = shard_map(
+        f = _jit_shmap(
             local_steps,
             mesh=mesh,
             in_specs=(P(), P("data"), P("data")),

@@ -37,8 +37,11 @@ def tp_mesh():
     return parallel_state.initialize_model_parallel(TP, 1, devices=devs[:TP])
 
 
+from _helpers import jit_shmap
+
+
 def shmap(mesh, fn, in_specs, out_specs):
-    return shard_map(
+    return jit_shmap(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
     )
 
