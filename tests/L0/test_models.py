@@ -102,10 +102,12 @@ class TestResNet:
             y, _ = m.apply(variables, x, mutable=["batch_stats"])
             return y
 
-        f = jax.jit(shard_map(
+        from _helpers import jit_shmap
+
+        f = jit_shmap(
             local, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
             check_rep=False,
-        ))
+        )
         y = f(x)
         assert y.shape == (4, 4)
 

@@ -17,6 +17,8 @@ import pytest
 from jax.sharding import PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from _helpers import jit_shmap
+
 from rocm_apex_tpu.transformer import parallel_state, tensor_parallel
 from rocm_apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear,
@@ -35,9 +37,6 @@ def tp_mesh():
     if len(devs) < TP:
         pytest.skip(f"needs {TP} simulated devices")
     return parallel_state.initialize_model_parallel(TP, 1, devices=devs[:TP])
-
-
-from _helpers import jit_shmap
 
 
 def shmap(mesh, fn, in_specs, out_specs):
