@@ -52,6 +52,14 @@ __all__ = [
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+# Kernel dots PIN native MXU precision rather than inheriting
+# jax_default_matmul_precision: Mosaic rejects non-native precisions on
+# bf16 operands outright ("Bad lhs type" under 'highest'), so a global
+# precision override would crash every bf16 training path. Like any
+# hand-written kernel (cuDNN flash attention under torch's matmul
+# flags), these kernels define their own numerics: bf16 operands on the
+# MXU with fp32 accumulation.
+_PREC = jax.lax.Precision.DEFAULT
 
 
 def _round_up(x, m):
@@ -112,7 +120,7 @@ def _masked_scores(
     # explicit fp32 upcast here would fall off the fast MXU path
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_PREC,
     ) * scale
     if bias_ref is not None:
         s = s + bias_ref[0].astype(jnp.float32)
@@ -192,7 +200,8 @@ def _fwd_kernel(
             )
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -317,7 +326,7 @@ def _bwd_dkv_kernel(
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         if dropout_rate > 0.0:
             # identical regeneration of the forward's keep mask
@@ -331,12 +340,12 @@ def _bwd_dkv_kernel(
             p_drop = p
         dv_scr[...] += jax.lax.dot_general(
             p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         ds = p * (dp - delta) * scale
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
 
     if causal:
@@ -382,7 +391,7 @@ def _bwd_dq_kernel(
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         if dropout_rate > 0.0:
             keep = _keep_mask(
@@ -391,7 +400,8 @@ def _bwd_dq_kernel(
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[...] += jax.lax.dot(
-            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
 
     if causal:
@@ -445,7 +455,7 @@ def _bwd_dbias_kernel(
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         if dropout_rate > 0.0:
             keep = _keep_mask(
@@ -818,7 +828,8 @@ def _fwd_single_kernel(
         p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
     safe_l = jnp.where(l > 0.0, l, 1.0)
     acc = jax.lax.dot(
-        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32, precision=_PREC,
     )
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(safe_l)
@@ -985,7 +996,7 @@ def _bwd_merged_kernel(
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_PREC,
     )
     if dropout_rate > 0.0:
         keep = _keep_mask(
@@ -998,14 +1009,14 @@ def _bwd_merged_kernel(
         p_drop = p
     dv = jax.lax.dot_general(
         p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_PREC,
     )
     ds = (p * (dp - delta) * scale).astype(q.dtype)
     dk = jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_PREC,
     )
-    dq = jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+    dq = jax.lax.dot(ds, k, preferred_element_type=jnp.float32, precision=_PREC,)
     dqkv_ref[0, :, :hd] = dq.astype(dqkv_ref.dtype)
     dqkv_ref[0, :, hd:2 * hd] = dk.astype(dqkv_ref.dtype)
     dqkv_ref[0, :, 2 * hd:] = dv.astype(dqkv_ref.dtype)

@@ -41,6 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 from rocm_apex_tpu.ops._pallas import pallas_call
 from rocm_apex_tpu.ops.flash_attention import (
     NEG_INF,
+    _PREC,
     _masked_scores,
     _round_up,
 )
@@ -90,7 +91,7 @@ def _seg_fwd_kernel(
         corr = jnp.exp(m_prev - m_new)
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32, precision=_PREC,
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -138,16 +139,16 @@ def _seg_dkv_kernel(
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         ds = p * (dp - delta) * scale
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
 
     pl.when(
@@ -190,11 +191,11 @@ def _seg_dq_kernel(
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=_PREC,
         )
         ds = p * (dp - delta) * scale
         dq_scr[...] += jax.lax.dot(
-            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32, precision=_PREC,
         )
 
     pl.when(

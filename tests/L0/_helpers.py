@@ -10,3 +10,30 @@ def jit_shmap(*args, **kwargs):
     CPU mesh and runs Pallas kernels in slow python-interpret mode —
     half the old suite runtime was exactly this."""
     return jax.jit(shard_map(*args, **kwargs))
+
+
+def assert_close(actual, desired, rtol=1e-7, atol=0.0, err_msg="",
+                 tpu_rtol=None, tpu_atol=None):
+    """np.testing.assert_allclose with a TPU tolerance floor.
+
+    Kernel tests compare Pallas outputs against jnp references at
+    fp32-exact CPU tolerances. On the real chip the jnp REFERENCE
+    itself runs MXU matmuls (bf16x3 decomposition), so both sides
+    carry ~1e-3-tier rounding — the CPU bounds are floored up there
+    and left untouched on CPU (the CI platform)."""
+    import numpy as np
+
+    if jax.default_backend() == "tpu":
+        # Default floor 2e-3 — tight enough that elementwise/reduction
+        # kernels (LN, softmax, CE) still verify at near-CPU fidelity.
+        # Matmul-bearing attention tests pass explicit tpu_rtol/
+        # tpu_atol (2e-2, or 1e-1 for grads through exp at a causal
+        # boundary): flash online-softmax rescaling + MXU fp32-as-
+        # bf16x3 put their kernel-vs-exact deltas at ~8e-3 abs on <1%
+        # of elements. A real logic bug (wrong mask/index) shows O(1)
+        # diffs on whole regions and fails either floor.
+        rtol = max(rtol, tpu_rtol if tpu_rtol is not None else 2e-3)
+        atol = max(atol, tpu_atol if tpu_atol is not None else 2e-3)
+    np.testing.assert_allclose(
+        actual, desired, rtol=rtol, atol=atol, err_msg=err_msg
+    )

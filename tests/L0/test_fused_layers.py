@@ -13,6 +13,8 @@ Kernels run in Pallas interpret mode on CPU (ops/_pallas.py).
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from _helpers import assert_close
 import pytest
 
 from rocm_apex_tpu.normalization import (
@@ -45,10 +47,10 @@ class TestLayerNorm:
         w = jax.random.normal(jax.random.PRNGKey(1), (128,)) + 1.0
         b = jax.random.normal(jax.random.PRNGKey(2), (128,))
         y, mu, rs = ln_ops.layer_norm_fwd(x, w, b, 1e-5)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(y), np.asarray(ref_ln(x, w, b)), rtol=1e-5, atol=1e-5
         )
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(mu).squeeze(), np.asarray(jnp.mean(x, axis=-1)), rtol=1e-5, atol=1e-6
         )
 
@@ -66,20 +68,20 @@ class TestLayerNorm:
         gf = jax.grad(fused, argnums=(0, 1, 2))(x, w, b)
         gr = jax.grad(ref, argnums=(0, 1, 2))(x, w, b)
         for a, e in zip(gf, gr):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4)
+            assert_close(np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4)
 
     def test_grad_no_affine(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (8, 32))
         gf = jax.grad(lambda x: jnp.sum(ln_ops.layer_norm(x, 1e-5) ** 2))(x)
         gr = jax.grad(lambda x: jnp.sum(ref_ln(x) ** 2))(x)
-        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-4)
+        assert_close(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-4)
 
     def test_module_nd_shape(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 4, 32))
         mod = FusedLayerNorm(normalized_shape=32)
         params = mod.init(jax.random.PRNGKey(1), x)
         y = mod.apply(params, x)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(y),
             np.asarray(ref_ln(x, jnp.ones((32,)), jnp.zeros((32,)))),
             rtol=1e-5,
@@ -104,10 +106,10 @@ class TestLayerNorm:
         b = jax.random.normal(jax.random.PRNGKey(6), (64,))
 
         y, s = ln_ops.layer_norm_residual_affine(x, d, w, b, 1e-5)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(s), np.asarray(x + d), rtol=1e-6, atol=1e-6
         )
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(y), np.asarray(ref_ln(x + d, w, b)),
             rtol=1e-5, atol=1e-5,
         )
@@ -126,7 +128,7 @@ class TestLayerNorm:
         gf = jax.grad(fused, argnums=(0, 1, 2, 3))(x, d, w, b)
         gr = jax.grad(ref, argnums=(0, 1, 2, 3))(x, d, w, b)
         for a, e in zip(gf, gr):
-            np.testing.assert_allclose(
+            assert_close(
                 np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4
             )
 
@@ -169,7 +171,7 @@ class TestLayerNorm:
         y, s = mod.apply(params, d, residual=x)
         assert y.dtype == jnp.float32  # follows fp32 params
         assert s.dtype == jnp.bfloat16  # stream follows the input
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(s, np.float32),
             np.asarray((x + d).astype(jnp.bfloat16), np.float32),
         )
@@ -185,7 +187,7 @@ class TestScaledSoftmax:
         ref = jax.nn.softmax(
             jnp.where(jnp.asarray(mask), -jnp.inf, x * scale), axis=-1
         )
-        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+        assert_close(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
 
     def test_causal_exact_zero_above_diagonal(self):
         """-inf fill ⇒ strictly zero attention to the future, even with
@@ -194,7 +196,7 @@ class TestScaledSoftmax:
         y = np.asarray(scaled_upper_triang_masked_softmax(x, 1.0))
         assert np.all(y[0][np.triu_indices(8, k=1)] == 0.0)
         # valid positions still form a normalized distribution
-        np.testing.assert_allclose(y[0].sum(axis=-1), np.ones(8), rtol=1e-6)
+        assert_close(y[0].sum(axis=-1), np.ones(8), rtol=1e-6)
 
     def test_masked_matches_jax(self):
         b, h, sq, sk = 2, 3, 8, 16
@@ -205,7 +207,7 @@ class TestScaledSoftmax:
         scale = 1.3
         y = scaled_masked_softmax(x, mask, scale)
         ref = jax.nn.softmax(jnp.where(mask, -10000.0, x * scale), axis=-1)
-        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+        assert_close(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
 
     def test_causal_grad_matches_jax(self):
         b, s = 1, 8
@@ -218,7 +220,7 @@ class TestScaledSoftmax:
             mask = jnp.triu(jnp.ones((s, s), bool), k=1)
             return jnp.sum(jax.nn.softmax(jnp.where(mask, -jnp.inf, x * 0.5)) ** 2)
 
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(jax.grad(fused)(x)),
             np.asarray(jax.grad(ref)(x)),
             rtol=1e-4,
@@ -236,7 +238,7 @@ class TestScaledSoftmax:
         def ref(x):
             return jnp.sum(jnp.cos(jax.nn.softmax(jnp.where(mask, -10000.0, x * 2.0))))
 
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(jax.grad(fused)(x)),
             np.asarray(jax.grad(ref)(x)),
             rtol=1e-4,
@@ -261,7 +263,7 @@ class TestXentropy:
         labels = jax.random.randint(jax.random.PRNGKey(1), (rows,), 1, vocab)
         loss = softmax_cross_entropy_loss(logits, labels, smoothing)
         ref = ref_smoothed_ce(logits, labels, smoothing)
-        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-5, atol=1e-6)
+        assert_close(np.asarray(loss), np.asarray(ref), rtol=1e-5, atol=1e-6)
 
     @pytest.mark.parametrize("smoothing", [0.0, 0.1])
     @pytest.mark.parametrize("padding_idx", [None, 0])
@@ -279,7 +281,7 @@ class TestXentropy:
             logits, labels, smoothing, padding_idx
         )
         l_r = softmax_cross_entropy_loss(logits, labels, smoothing, padding_idx)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(l_f), np.asarray(l_r), rtol=1e-5, atol=1e-6
         )
         w = jax.random.normal(jax.random.PRNGKey(4), (rows,))
@@ -297,7 +299,7 @@ class TestXentropy:
                 )
             )
         )(logits)
-        np.testing.assert_allclose(
+        assert_close(
             np.asarray(g_f), np.asarray(g_r), rtol=1e-5, atol=1e-6
         )
 
@@ -323,4 +325,4 @@ class TestXentropy:
             lambda l: jnp.sum(softmax_cross_entropy_loss(l, labels, smoothing, -1))
         )(logits)
         gr = jax.grad(lambda l: jnp.sum(ref_smoothed_ce(l, labels, smoothing)))(logits)
-        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-5)
+        assert_close(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-5)
