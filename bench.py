@@ -163,19 +163,20 @@ def bench_rn50():
     )
 
 
-def bench_bert():
+def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
     """BASELINE.json config 4: BERT-Large-shaped MLM pretrain step with
     FusedLAMB + fused LayerNorm, tokens/sec/chip. 24L/1024h with
-    head_dim 128 (the TPU-first head shape; see main())."""
+    head_dim 128 (the TPU-first head shape; see main()).
+    ``--batch=16 --remat`` measures the large-batch config with
+    per-layer activation checkpointing (the b16 fit path)."""
     from rocm_apex_tpu.models import BertConfig, BertModel
     from rocm_apex_tpu.optimizers import fused_lamb
     from rocm_apex_tpu.utils.tree import path_str
 
     on_tpu = jax.default_backend() == "tpu"
-    # b8 fits since the round-3 kernel work (merged attention backward
-    # + one-pass CE shrank the live-buffer set); b16 still exhausts the
-    # 16 GB chip (330M params of fp32 LAMB p/m/v + activations)
-    batch = 8 if on_tpu else 2
+    # b8 fits without remat; b16 needs per-layer remat (330M params of
+    # fp32 LAMB p/m/v leave ~6 GB for activations on the 16 GB chip)
+    batch = batch or (8 if on_tpu else 2)
     seq = 512 if on_tpu else 64
     iters = 20 if on_tpu else 2
     cfg = BertConfig(
@@ -185,9 +186,10 @@ def bench_bert():
         num_attention_heads=8 if on_tpu else 4,
         ffn_hidden_size=4096 if on_tpu else 128,
         max_position_embeddings=seq,
-        hidden_dropout=0.0,
-        attention_dropout=0.0,
+        hidden_dropout=dropout,
+        attention_dropout=dropout,
         tensor_parallel_size=1,
+        checkpoint_activations=remat,
     )
     model = BertModel(cfg)
     tokens = jax.random.randint(
@@ -205,10 +207,15 @@ def bench_bert():
     opt_state = opt.init(params)
 
     def one_step(carry, _):
-        params, opt_state = carry
+        params, opt_state, rng = carry
+        rng, step_rng = jax.random.split(rng)
 
         def loss_fn(p):
-            losses, _ = model.apply(p, tokens, lm_labels=lm_labels)
+            losses, _ = model.apply(
+                p, tokens, lm_labels=lm_labels,
+                deterministic=dropout == 0.0,
+                rngs={"dropout": step_rng} if dropout > 0.0 else None,
+            )
             return jnp.mean(losses)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -218,16 +225,16 @@ def bench_bert():
             params,
             updates,
         )
-        return (params2, opt_state2), loss
+        return (params2, opt_state2, rng), loss
 
     @jax.jit
-    def runN(params, opt_state):
+    def runN(params, opt_state, rng):
         carry, losses = jax.lax.scan(
-            one_step, (params, opt_state), None, length=iters
+            one_step, (params, opt_state, rng), None, length=iters
         )
         return carry, losses
 
-    carry, losses = runN(params, opt_state)
+    carry, losses = runN(params, opt_state, jax.random.PRNGKey(2))
     float(losses[-1])
     t0 = time.perf_counter()
     carry, losses = runN(*carry)
@@ -237,14 +244,26 @@ def bench_bert():
     n_params = sum(
         int(x.size) for x in jax.tree_util.tree_leaves(params)
     ) - cfg.vocab_size * cfg.hidden_size
-    flops = 6.0 * n_params * batch * seq + (
-        12.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
+    # same Megatron-style crediting as the GPT bench: + the tied
+    # MLM-head projection trio (see main())
+    flops = (
+        6.0 * n_params * batch * seq
+        + 12.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
+        + 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size
     )
     mfu = (flops / dt) / peak_flops_per_chip()
+    # non-default configs get distinct metric names: the driver's
+    # BASELINE series must never mix configs under one key
+    suffix = "_dropout" if dropout > 0.0 else ""
+    if batch != (8 if on_tpu else 2):
+        suffix += f"_b{batch}"
+    if remat:
+        suffix += "_remat"
     _report(
-        "bert_large_train_tokens_per_sec_per_chip", tok_s, "tokens/s",
-        mfu / 0.70,
-        f"bert: step={dt*1000:.1f}ms loss={loss:.3f} mfu={mfu:.3f}",
+        f"bert_large_train_tokens_per_sec_per_chip{suffix}", tok_s,
+        "tokens/s", mfu / 0.70,
+        f"bert: step={dt*1000:.1f}ms loss={loss:.3f} mfu={mfu:.3f} "
+        f"dropout={dropout} remat={remat}",
     )
 
 
@@ -526,7 +545,7 @@ def bench_ln():
     )
 
 
-def main():
+def main(dropout: float = 0.0):
     on_tpu = jax.default_backend() == "tpu"
     # head_dim = hidden/heads = 128 = the MXU lane width. hd=64 pads
     # every attention operand to 128 lanes and wastes half the MXU —
@@ -538,8 +557,8 @@ def main():
         num_layers=8 if on_tpu else 2,
         num_attention_heads=8 if on_tpu else 4,
         max_position_embeddings=SEQ if on_tpu else 128,
-        hidden_dropout=0.0,
-        attention_dropout=0.0,
+        hidden_dropout=dropout,
+        attention_dropout=dropout,
         tensor_parallel_size=1,
     )
     seq = min(SEQ, cfg.max_position_embeddings)
@@ -554,12 +573,18 @@ def main():
     params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
     state = opt.init(params32)
     sstate = scaler.init()
+    rng0 = jax.random.PRNGKey(2)
 
     def one_step(carry, _):
-        state, sstate = carry
+        state, sstate, rng = carry
+        rng, step_rng = jax.random.split(rng)
 
         def loss_fn(params):
-            losses = model.apply(params, tokens, labels=labels)
+            losses = model.apply(
+                params, tokens, labels=labels,
+                deterministic=dropout == 0.0,
+                rngs={"dropout": step_rng} if dropout > 0.0 else None,
+            )
             return gpt_loss_fn(losses) * scaler.loss_scale(sstate)
 
         scaled, grads = jax.value_and_grad(loss_fn)(state.model)
@@ -570,22 +595,22 @@ def main():
             state, grads, grad_scale=inv_scale
         )
         sstate2, _ = scaler.update(sstate, found_inf)
-        return (state2, sstate2), scaled * inv_scale
+        return (state2, sstate2, rng), scaled * inv_scale
 
     @jax.jit
-    def runN(state, sstate):
+    def runN(state, sstate, rng):
         # unroll=2 halves the while-loop bookkeeping between steps
         # (measured -0.9 ms/step) at the cost of one extra body compile
-        (state, sstate), losses = jax.lax.scan(
-            one_step, (state, sstate), None, length=ITERS, unroll=2
+        (state, sstate, rng), losses = jax.lax.scan(
+            one_step, (state, sstate, rng), None, length=ITERS, unroll=2
         )
-        return state, sstate, losses
+        return state, sstate, rng, losses
 
-    state, sstate, losses = runN(state, sstate)
+    state, sstate, rng0, losses = runN(state, sstate, rng0)
     float(losses[-1])  # warmup + sync (value fetch, not block_until_ready)
 
     t0 = time.perf_counter()
-    state, sstate, losses = runN(state, sstate)
+    state, sstate, rng0, losses = runN(state, sstate, rng0)
     loss = float(losses[-1])
     dt = (time.perf_counter() - t0) / ITERS
 
@@ -593,22 +618,41 @@ def main():
     n_params = sum(
         int(x.size) for x in jax.tree_util.tree_leaves(params32)
     ) - cfg.vocab_size * cfg.hidden_size
-    model_flops = 6.0 * n_params * BATCH * seq + (
-        12.0 * cfg.num_layers * BATCH * seq * seq * cfg.hidden_size
+    # Model FLOPs, Megatron-style (Narayanan et al. 2021, the logit-
+    # layer term of their eq. 3; PaLM appendix B counts it the same
+    # way): 6·N over the non-embedding params, + the attention scores/
+    # context matmuls, + 6·B·s·h·V for the LM-head projection trio
+    # (fwd + dW + dx on the tied table — 17.3 ms/step of 94-98%-of-peak
+    # MXU work on this config, real dense math the round-3 formula
+    # credited at zero; BASELINE.md "MFU crediting" documents both
+    # numbers and the driver JSON carries the head-inclusive one).
+    model_flops = (
+        6.0 * n_params * BATCH * seq
+        + 12.0 * cfg.num_layers * BATCH * seq * seq * cfg.hidden_size
+        + 6.0 * BATCH * seq * cfg.hidden_size * cfg.vocab_size
     )
     mfu = (model_flops / dt) / peak_flops_per_chip()
+    mfu_sans_head = (
+        (model_flops - 6.0 * BATCH * seq * cfg.hidden_size * cfg.vocab_size)
+        / dt
+    ) / peak_flops_per_chip()
+    suffix = "_dropout" if dropout > 0.0 else ""
     _report(
-        "gpt_train_tokens_per_sec_per_chip", tokens_per_sec, "tokens/s",
-        mfu / 0.70,
+        f"gpt_train_tokens_per_sec_per_chip{suffix}", tokens_per_sec,
+        "tokens/s", mfu / 0.70,
         f"step={dt*1000:.1f}ms loss={loss:.4f} mfu={mfu:.3f} "
-        f"backend={jax.default_backend()}",
+        f"(sans-head crediting: {mfu_sans_head:.3f}) "
+        f"dropout={dropout} backend={jax.default_backend()}",
     )
 
 
 if __name__ == "__main__":
     # driver contract: plain `python bench.py` = the flagship GPT line.
     # `python bench.py rn50|bert` measures the other BASELINE.json
-    # configs (results recorded in BASELINE.md).
+    # configs (results recorded in BASELINE.md). `--dropout=R` on the
+    # gpt/bert benches measures the TRAINING config (attention dropout
+    # through the in-kernel flash dropout, hidden dropout through the
+    # fused LN-dropout path).
     benches = {
         "gpt": main,
         "rn50": bench_rn50,
@@ -618,9 +662,25 @@ if __name__ == "__main__":
         "optim": bench_optim,
         "ln": bench_ln,
     }
-    which = sys.argv[1] if len(sys.argv) > 1 else "gpt"
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    kwargs = {}
+    for a in sys.argv[1:]:
+        if a.startswith("--dropout="):
+            kwargs["dropout"] = float(a.split("=", 1)[1])
+        elif a.startswith("--batch="):
+            kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a == "--remat":
+            kwargs["remat"] = True
+        elif a.startswith("--"):
+            # a typoed flag must not silently measure the wrong config
+            raise SystemExit(f"unknown flag {a!r}")
+    which = args[0] if args else "gpt"
     if which not in benches:
         raise SystemExit(
             f"unknown benchmark {which!r}; choose from {sorted(benches)}"
         )
-    benches[which]()
+    if "dropout" in kwargs and which not in ("gpt", "bert"):
+        raise SystemExit(f"--dropout applies to gpt/bert, not {which!r}")
+    if ("batch" in kwargs or "remat" in kwargs) and which != "bert":
+        raise SystemExit("--batch/--remat apply to the bert bench")
+    benches[which](**kwargs)

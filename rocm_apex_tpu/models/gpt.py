@@ -211,10 +211,14 @@ class ParallelAttention(nn.Module):
         from rocm_apex_tpu.ops._pallas import on_tpu
 
         dropout_active = cfg.attention_dropout > 0.0 and not deterministic
+        # in-kernel dropout covers BOTH mask types: causal rides the
+        # packed kernels, padding rides the additive-bias kernels (the
+        # reference's fmha/multihead_attn dropout kernels serve BERT's
+        # bidirectional masks the same way)
         use_flash_dropout = (
             cfg.attention_impl == "flash"
             and dropout_active
-            and self.attn_mask_type == "causal"
+            and self.attn_mask_type in ("causal", "padding")
             and cfg.context_parallel_axis is None
             and on_tpu()
         )
@@ -342,9 +346,19 @@ class ParallelAttention(nn.Module):
                     0.0,
                 ).astype(jnp.float32)[:, 0]
                 # fb is a constant padding mask: no dbias kernel
-                ctxf = flash_attention(
-                    qf, kf, vf, fb, False, scale, compute_dbias=False
-                )
+                if use_flash_dropout:
+                    from rocm_apex_tpu.ops.flash_attention import (
+                        flash_attention_dropout,
+                    )
+
+                    ctxf = flash_attention_dropout(
+                        qf, kf, vf, fb, _dropout_seed(),
+                        cfg.attention_dropout, False, scale,
+                    )
+                else:
+                    ctxf = flash_attention(
+                        qf, kf, vf, fb, False, scale, compute_dbias=False
+                    )
             ctx = (
                 ctxf.reshape(b, nh_local, sq, hd)
                 .transpose(0, 2, 1, 3)

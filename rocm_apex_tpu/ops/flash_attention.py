@@ -52,6 +52,15 @@ __all__ = [
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+# Scores are kept in BASE 2 inside every kernel: scale·log2(e) folds
+# into the (block, head_dim) q tile before the MXU dot — one multiply
+# over d columns instead of block_k — and the softmax runs on exp2
+# (the VPU's native exponential; exp(x) would spend an extra full-tile
+# multiply folding log2e back in). lse converts to natural log at the
+# kernel boundary, so the public API (and the ring-attention lse
+# combine) is unchanged.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
 # Kernel dots PIN native MXU precision rather than inheriting
 # jax_default_matmul_precision: Mosaic rejects non-native precisions on
 # bf16 operands outright ("Bad lhs type" under 'highest'), so a global
@@ -98,10 +107,11 @@ def _keep_mask(seed_ref, rate, b, qi, ki, shape):
     h = h ^ (h >> 13)
     h = h * jnp.uint32(0xC2B2AE35)
     h = h ^ (h >> 16)
-    # u32 -> s32 convert_element_type is bit-preserving at equal width
-    # (XLA wraps on overflow), and — unlike a scalar bitcast — lowers on
-    # current Mosaic, which rejects 'tpu.bitcast' on non-vector operands
-    pltpu.prng_seed(h.astype(jnp.int32))
+    # mask to 31 bits first: u32->s32 conversion is only
+    # defined-behavior in XLA's ConvertElementType for in-range values,
+    # and a scalar bitcast is rejected by current Mosaic ('tpu.bitcast'
+    # on non-vector operands); one seed bit of entropy is immaterial
+    pltpu.prng_seed((h & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32))
     bits = pltpu.prng_random_bits(shape)
     thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return bits.astype(jnp.uint32) >= thresh
@@ -111,19 +121,26 @@ def _masked_scores(
     causal, scale, sk_real, block_q, block_k,
     q, k, bias_ref, len_ref, b, qi, ki, seg=None,
 ):
-    """The masked fp32 score block for grid point (b, qi, ki) — shared
-    by ALL FOUR kernels (fwd, dkv, dq, dbias). Masking semantics live
-    here and only here: a change applied to one kernel but not the
+    """The masked BASE-2 score block for grid point (b, qi, ki) —
+    shared by ALL FOUR kernels (fwd, dkv, dq, dbias). Masking semantics
+    live here and only here: a change applied to one kernel but not the
     others would silently desynchronize forward and backward
-    probabilities."""
+    probabilities.
+
+    Returns log2-domain scores: `exp2(s - m)` reproduces the natural-
+    domain softmax exactly (scale·log2e is folded into the q tile —
+    the narrow operand — before the dot)."""
     # native-dtype MXU operands (bf16 in / fp32 accumulate); an
-    # explicit fp32 upcast here would fall off the fast MXU path
+    # explicit fp32 upcast here would fall off the fast MXU path.
+    # q·(scale·log2e) rounds in q's dtype — the same 2^-8-tier relative
+    # rounding the bf16 operands already carry into the MXU
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        (q * jnp.asarray(scale * LOG2E, q.dtype)), k,
+        (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=_PREC,
-    ) * scale
+    )
     if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
+        s = s + bias_ref[0].astype(jnp.float32) * LOG2E
     col = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
@@ -188,8 +205,8 @@ def _fwd_kernel(
 
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
         # the softmax normalizer uses the UNdropped probabilities;
         # dropout zeroes entries of the normalized matrix (torch order:
         # softmax -> dropout -> @v)
@@ -216,7 +233,8 @@ def _fwd_kernel(
         l = l_scr[:, :1]
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(safe_l))
+        # natural-log lse at the boundary (base-2 internally)
+        lse_ref[0] = (m_scr[:, :1] + jnp.log2(safe_l)) * LN2
 
 
 def _fwd(q, k, v, bias, causal, scale, block_q, block_k,
@@ -323,7 +341,7 @@ def _bwd_dkv_kernel(
             causal, scale, sk_real, block_q, block_k,
             q, k, bias_ref, len_ref, b, qi, ki,
         )
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse * LOG2E)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
@@ -342,7 +360,9 @@ def _bwd_dkv_kernel(
             p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
         )
-        ds = p * (dp - delta) * scale
+        # unscaled ds: the outer q·k scale is applied once to the
+        # accumulated (block, d) result at finish, not per score tile
+        ds = p * (dp - delta)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
@@ -355,7 +375,7 @@ def _bwd_dkv_kernel(
 
     @pl.when(qi == nq - 1)
     def _finish():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -388,7 +408,7 @@ def _bwd_dq_kernel(
             causal, scale, sk_real, block_q, block_k,
             q, k, bias_ref, len_ref, b, qi, ki,
         )
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse * LOG2E)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
@@ -398,7 +418,8 @@ def _bwd_dq_kernel(
                 seed_ref, dropout_rate, b, qi, ki, (block_q, block_k)
             )
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
-        ds = p * (dp - delta) * scale
+        # unscaled ds; the scale lands on the accumulated dq at finish
+        ds = p * (dp - delta)
         dq_scr[...] += jax.lax.dot(
             ds.astype(k.dtype), k,
             preferred_element_type=jnp.float32, precision=_PREC,
@@ -411,7 +432,7 @@ def _bwd_dq_kernel(
 
     @pl.when(ki == nk - 1)
     def _finish():
-        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dbias_kernel(
@@ -452,7 +473,7 @@ def _bwd_dbias_kernel(
             causal, scale, sk_real, block_q, block_k,
             q, k, bias_ref, len_ref, b, qi, ki,
         )
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse * LOG2E)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
@@ -679,24 +700,26 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
-    compute_dbias: bool = True,
+    compute_dbias: bool = False,
 ) -> jnp.ndarray:
     """Flash attention over (batch*heads, seq, head_dim) operands.
 
     ``bias`` additive (bh | 1, sq, sk); ``causal`` in-kernel triangular
     mask; ``scale`` defaults to 1/sqrt(head_dim). Differentiable in
-    q/k/v AND bias: learned additive biases (ALiBi slopes, relative
-    position) train correctly — dbias is computed by a dedicated
-    kernel summing ds over each bias row's head group.
+    q/k/v, and in bias when ``compute_dbias=True``: learned additive
+    biases (ALiBi slopes, relative position) train correctly — dbias is
+    computed by a dedicated kernel summing ds over each bias row's head
+    group.
 
-    PERFORMANCE NOTE: ``compute_dbias`` defaults to True so learned
-    biases never silently get zero gradients. The dbias kernel
-    materializes an O(bh·sq·sk) fp32 buffer; under jit XLA dead-code-
-    eliminates it whenever the bias cotangent is unused, but an EAGER
-    (non-jit) differentiated call pays for it regardless. Callers whose
-    bias is a constant mask (padding/causal combinations) should pass
-    ``compute_dbias=False`` to skip the kernel and the buffer
-    explicitly.
+    PERFORMANCE NOTE: ``compute_dbias`` defaults to False because the
+    common bias is a constant mask (padding/causal combinations) whose
+    gradient nobody reads — and the dbias kernel materializes an
+    O(bh·sq·sk) fp32 buffer that an EAGER (non-jit) differentiated call
+    pays for even when the cotangent is discarded. Under the default
+    the bias cotangent is exact zeros with no kernel launch and no
+    quadratic buffer. Training a LEARNED bias requires the explicit
+    ``compute_dbias=True`` opt-in; forgetting it is loud (the bias
+    never moves), not silently slow.
     """
     o, _ = _fwd(
         q, k, v, bias, causal,
@@ -819,7 +842,7 @@ def _fwd_single_kernel(
         q, k, None, None, b, zero, zero,
     )
     m = jnp.max(s, axis=1, keepdims=True)
-    p = jnp.exp(s - m)
+    p = jnp.exp2(s - m)
     l = jnp.sum(p, axis=1, keepdims=True)
     if dropout_rate > 0.0:
         keep = _keep_mask(
@@ -832,7 +855,7 @@ def _fwd_single_kernel(
         preferred_element_type=jnp.float32, precision=_PREC,
     )
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(safe_l)
+    lse_ref[0] = (m + jnp.log2(safe_l)) * LN2
 
 
 def _fwd_packed(qkv, causal, scale, block_q, block_k,
@@ -993,7 +1016,7 @@ def _bwd_merged_kernel(
         causal, scale, sk_real, block_q, block_k,
         q, k, None, None, b, zero, zero,
     )
-    p = jnp.exp(s - lse)
+    p = jnp.exp2(s - lse * LOG2E)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=_PREC,
@@ -1011,12 +1034,16 @@ def _bwd_merged_kernel(
         p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32, precision=_PREC,
     )
-    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    # unscaled ds: the q·k scale is applied to the (block, d) dq/dk
+    # results, not the (block, block) score tile
+    ds = (p * (dp - delta)).astype(q.dtype)
     dk = jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32, precision=_PREC,
-    )
-    dq = jax.lax.dot(ds, k, preferred_element_type=jnp.float32, precision=_PREC,)
+    ) * scale
+    dq = jax.lax.dot(
+        ds, k, preferred_element_type=jnp.float32, precision=_PREC,
+    ) * scale
     dqkv_ref[0, :, :hd] = dq.astype(dqkv_ref.dtype)
     dqkv_ref[0, :, hd:2 * hd] = dk.astype(dqkv_ref.dtype)
     dqkv_ref[0, :, 2 * hd:] = dv.astype(dqkv_ref.dtype)
@@ -1128,7 +1155,14 @@ def _bwd_packed(causal, scale, block_q, block_k, res, do,
         )
     if qkv_bias is not None:
         # multi-tile fallback: biased operands via the pre-add (the
-        # kernels then see the same values), dbias via an XLA reduce
+        # kernels then see the same values), dbias via an XLA reduce.
+        # PRECISION: the reduce sums dqkv AFTER it is rounded to the
+        # qkv dtype (bf16), whereas the single-tile merged path
+        # accumulates fp32 partials in VMEM before casting — bias-grad
+        # error here grows ~sqrt(B*S)·2^-8 relative. Acceptable for a
+        # fallback (bias grads are O(B*S) sums either way and feed an
+        # fp32 master update); emit fp32 partials from the split
+        # kernels if large-B*S bias fidelity ever matters.
         qkv = qkv + qkv_bias.reshape(nh, three_hd).astype(qkv.dtype)
         dqkv = _bwd_packed(
             causal, scale, block_q, block_k, (qkv, o, lse), do,
@@ -1470,7 +1504,7 @@ def _fal_bwd(causal, scale, block_q, block_k, res, cot):
 flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def flash_attention_dropout(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -1482,6 +1516,7 @@ def flash_attention_dropout(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    compute_dbias: bool = False,
 ) -> jnp.ndarray:
     """`flash_attention` with in-kernel attention dropout.
 
@@ -1506,7 +1541,7 @@ def flash_attention_dropout(
 
 
 def _fad_fwd(q, k, v, bias, dropout_seed, dropout_rate, causal, scale,
-             block_q, block_k):
+             block_q, block_k, compute_dbias):
     s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     o, lse = _fwd(
         q, k, v, bias, causal, s, block_q, block_k,
@@ -1515,12 +1550,14 @@ def _fad_fwd(q, k, v, bias, dropout_seed, dropout_rate, causal, scale,
     return o, (q, k, v, bias, o, lse, dropout_seed)
 
 
-def _fad_bwd(dropout_rate, causal, scale, block_q, block_k, res, do):
+def _fad_bwd(dropout_rate, causal, scale, block_q, block_k,
+             compute_dbias, res, do):
     q, k, v, bias, o, lse, seed = res
     s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     dq, dk, dv, dbias = _bwd(
         causal, s, block_q, block_k, (q, k, v, bias, o, lse), do,
         dropout_rate=dropout_rate, dropout_seed=seed,
+        compute_dbias=compute_dbias,
     )
     seed_ct = np.zeros((), jax.dtypes.float0)
     return (dq, dk, dv, dbias, seed_ct)

@@ -30,6 +30,7 @@ only — same unspecified-row contract as `flash_attention_varlen`).
 """
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -40,6 +41,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from rocm_apex_tpu.ops._pallas import pallas_call
 from rocm_apex_tpu.ops.flash_attention import (
+    LN2,
+    LOG2E,
     NEG_INF,
     _PREC,
     _masked_scores,
@@ -87,8 +90,9 @@ def _seg_fwd_kernel(
         )
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        # _masked_scores returns BASE-2 scores (flash_attention.py)
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32, precision=_PREC,
@@ -106,7 +110,7 @@ def _seg_fwd_kernel(
         l = l_scr[:, :1]
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, :1] + jnp.log(safe_l)
+        lse_ref[0] = (m_scr[:, :1] + jnp.log2(safe_l)) * LN2
 
 
 def _seg_dkv_kernel(
@@ -136,7 +140,7 @@ def _seg_dkv_kernel(
             causal, scale, k.shape[0] * pl.num_programs(1), block_q,
             block_k, q, k, None, None, b, qi, ki, seg=(sq_ref, sk_ref),
         )
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse * LOG2E)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
@@ -145,7 +149,7 @@ def _seg_dkv_kernel(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
         )
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
@@ -158,7 +162,7 @@ def _seg_dkv_kernel(
 
     @pl.when(qi == nq - 1)
     def _finish():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -188,12 +192,12 @@ def _seg_dq_kernel(
             causal, scale, k.shape[0] * pl.num_programs(2), block_q,
             block_k, q, k, None, None, b, qi, ki, seg=(sq_ref, sk_ref),
         )
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse * LOG2E)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_PREC,
         )
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
         dq_scr[...] += jax.lax.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32, precision=_PREC,
         )
@@ -205,7 +209,7 @@ def _seg_dq_kernel(
 
     @pl.when(ki == nk - 1)
     def _finish():
-        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _prepare(q, seg, block_q, block_k):
@@ -214,8 +218,10 @@ def _prepare(q, seg, block_q, block_k):
     block_q = min(block_q, _round_up(total, 128))
     block_k = min(block_k, _round_up(total, 128))
     # one padded length serves both grid axes (self-attention: q and k
-    # are the same token stream)
-    block = max(block_q, block_k)
+    # are the same token stream); the lcm keeps tp divisible by BOTH
+    # block sizes when the smaller does not divide the larger
+    # (e.g. block_q=512, block_k=768)
+    block = math.lcm(block_q, block_k)
     tp = _round_up(total, block)
     segp = jnp.pad(
         seg.astype(jnp.int32), (0, tp - total), constant_values=-1

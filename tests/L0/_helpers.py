@@ -37,3 +37,20 @@ def assert_close(actual, desired, rtol=1e-7, atol=0.0, err_msg="",
     np.testing.assert_allclose(
         actual, desired, rtol=rtol, atol=atol, err_msg=err_msg
     )
+    if jax.default_backend() == "tpu" and max(rtol, atol) > 5e-2:
+        # Round-3 advisor: a 1e-1 floor alone could pass a small
+        # SYSTEMATIC error (e.g. a mis-scaled dbias term) that CPU CI
+        # catches only on its own path. Rounding outliers at a causal
+        # exp boundary are sparse (~0.04% of elements measured
+        # on-chip); a mis-scaled term is dense. Bound the fraction of
+        # elements outside the mid-tier (2e-2, 2e-2) band instead of
+        # trusting the loose global floor.
+        a = np.asarray(actual, dtype=np.float64)
+        d = np.asarray(desired, dtype=np.float64)
+        bad = np.abs(a - d) > 2e-2 + 2e-2 * np.abs(d)
+        frac = float(np.mean(bad))
+        assert frac <= 5e-3, (
+            f"{frac:.2%} of elements outside the (2e-2, 2e-2) band — "
+            f"loose-floor comparison would hide a systematic error. "
+            f"{err_msg}"
+        )
