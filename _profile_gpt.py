@@ -82,11 +82,9 @@ def main():
     defs = {}
     for line in hlo.splitlines():
         t = line.strip()
-        for tok in ("fusion.", "jvp_", "self_attention", "convolution"):
-            if t.startswith("%") and "= " in t:
-                nm = t[1:].split(" ")[0]
-                defs.setdefault(nm, t[:240])
-                break
+        if t.startswith("%") and "= " in t:
+            nm = t[1:].split(" ")[0]
+            defs.setdefault(nm, t[:240])
 
     import re as _re
 

@@ -1,0 +1,156 @@
+"""Dev driver: dissect the conv3x3 fwd kernel cost at the l1 shape by
+ablating taps / masks / prologue / stats.
+
+Usage: python _tune_bneck2.py
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, H, W, C = 128, 56, 56, 64
+HW, PTOT = H * W, N * H * W
+LO = 64
+BP = 2048
+ITERS = 30
+
+
+def scan_time(make_step, init):
+    def run(n):
+        @jax.jit
+        def f(c):
+            return jax.lax.scan(lambda c, _: (make_step(c), None),
+                                c, None, length=n)[0]
+        return f
+
+    f1, f2 = run(ITERS), run(2 * ITERS)
+    for f in (f1, f2):
+        r = f(init)
+        float(jax.tree_util.tree_leaves(r)[0].reshape(-1)[0]
+              .astype(jnp.float32))
+
+    def best(f):
+        ts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            r = f(init)
+            float(jax.tree_util.tree_leaves(r)[0].reshape(-1)[0]
+                  .astype(jnp.float32))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    return max(best(f2) - best(f1), 1e-9) / ITERS * 1000
+
+
+def make(taps, masks, prologue, stats, fp32fin=False):
+    offs = [dy * W + dx for dy in (-1, 0, 1) for dx in (-1, 0, 1)][:taps]
+
+    def kern(xp, xm, xn, a, b, w_ref, y_ref, s1_ref, s2_ref):
+        j = pl.program_id(0)
+        p0 = j * BP
+        u = jnp.concatenate([xp[...], xm[...], xn[...]], axis=0)
+        if prologue:
+            s = u.astype(jnp.float32) * a[...] + b[...]
+            u = jnp.maximum(s, 0.0).astype(u.dtype)
+        acc = None
+        for t, off in enumerate(offs):
+            tap = u[LO + off: LO + off + BP]
+            if masks:
+                p = p0 + jax.lax.broadcasted_iota(jnp.int32, (BP, 1), 0)
+                q = p + off
+                valid = (q >= 0) & (q // HW == p // HW)
+                dx = (t % 3) - 1
+                col = p % W
+                if dx < 0:
+                    valid &= col >= 1
+                elif dx > 0:
+                    valid &= col <= W - 2
+                tap = jnp.where(valid, tap, jnp.zeros_like(tap))
+            d = jax.lax.dot_general(
+                tap, w_ref[t], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc = d if acc is None else acc + d
+        y_ref[...] = acc.astype(y_ref.dtype)
+        if stats:
+            @pl.when(j == 0)
+            def _():
+                s1_ref[...] = jnp.zeros_like(s1_ref)
+                s2_ref[...] = jnp.zeros_like(s2_ref)
+            s1_ref[...] += jnp.sum(acc, axis=0, keepdims=True)
+            s2_ref[...] += jnp.sum(acc * acc, axis=0, keepdims=True)
+        else:
+            s1_ref[...] = jnp.zeros_like(s1_ref)
+            s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    k = BP // LO
+    last = PTOT // LO - 1
+    specs = [
+        pl.BlockSpec((LO, C), lambda j: (jnp.maximum(j * k - 1, 0), 0)),
+        pl.BlockSpec((BP, C), lambda j: (j, 0)),
+        pl.BlockSpec((LO, C), lambda j: (jnp.minimum((j + 1) * k, last), 0)),
+        pl.BlockSpec((1, C), lambda j: (0, 0)),
+        pl.BlockSpec((1, C), lambda j: (0, 0)),
+        pl.BlockSpec((9, C, C), lambda j: (0, 0, 0)),
+    ]
+    return pl.pallas_call(
+        kern,
+        grid=(PTOT // BP,),
+        in_specs=specs,
+        out_specs=[
+            pl.BlockSpec((BP, C), lambda j: (j, 0)),
+            pl.BlockSpec((1, C), lambda j: (0, 0)),
+            pl.BlockSpec((1, C), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((PTOT, C), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+    )
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    x = (jax.random.normal(key, (PTOT, C)) * 0.5).astype(jnp.bfloat16)
+    a = jnp.ones((1, C), jnp.float32)
+    b = jnp.zeros((1, C), jnp.float32)
+    w = (jax.random.normal(key, (9, C, C)) * 0.05).astype(jnp.bfloat16)
+    gbmap = PTOT * C * 2 / 1e9
+
+    cases = [
+        ("full (9 taps, masks, prologue, stats)", (9, True, True, True)),
+        ("no masks", (9, False, True, True)),
+        ("no prologue", (9, True, False, True)),
+        ("no stats", (9, True, True, False)),
+        ("1 tap only", (1, True, True, True)),
+        ("3 taps", (3, True, True, True)),
+        ("bare (1 tap, nothing)", (1, False, False, False)),
+    ]
+    for name, cfg in cases:
+        call = make(*cfg)
+
+        def step(x):
+            y, s1, s2 = call(x, x[:LO], x[:LO], a, b, w)[0:3] if False else \
+                call(x[:LO], x, x[:LO], a, b, w)
+            return x + (y[0, :1] * 1e-30 + s1[0, :1].astype(jnp.bfloat16)
+                        * 0).astype(x.dtype)
+
+        # correct operand order: (prev, main, next)
+        def step(x):
+            y, s1, s2 = call(x, x, x, a, b, w)
+            return x + (y[0, :1] * 1e-30).astype(x.dtype)
+
+        t = scan_time(step, x)
+        print(f"{name:40s} {t:7.3f} ms ({2*gbmap/(t/1e3):5.0f} GB/s)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

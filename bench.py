@@ -78,11 +78,17 @@ def _report(metric, value, unit, vs_baseline, extra=""):
     )
 
 
-def bench_rn50():
+def bench_rn50(fused: bool = False):
     """BASELINE.json config 2: ResNet-50, O5 recipe (bf16 + fp32
     masters via amp.initialize) + FusedAdam, images/sec/chip.
     DDP-equivalent gradient psum degenerates on one chip (the
-    multi-chip path is exercised by tests/L0/test_parallel.py)."""
+    multi-chip path is exercised by tests/L0/test_parallel.py).
+    `--fused=1` routes the 13 stride-1 blocks through the fused Pallas
+    bottleneck kernels (ops/fused_bottleneck.py) and reports under a
+    `_fused`-suffixed key; the default XLA chain remains the headline
+    because Mosaic's shifted-tap conv lowering measures well below
+    XLA's native conv emitter at RN50 channel widths (BASELINE.md
+    round-4 fused-bottleneck section has the kernel-level numbers)."""
     import optax
 
     from rocm_apex_tpu import amp, models
@@ -100,6 +106,7 @@ def bench_rn50():
     model = models.resnet50(
         num_classes=1000,
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        fused=fused and on_tpu,
     )
     x0 = jnp.zeros((batch, size, size, 3))
     variables = model.init(jax.random.PRNGKey(0), x0)
@@ -157,8 +164,12 @@ def bench_rn50():
     img_s = batch / dt
     # RN50 train ~ 3 x 4.1 GFLOPs fwd per image at 224x224
     mfu = (12.3e9 * batch / dt) / peak_flops_per_chip()
+    # the driver's BASELINE series must never mix configs under one
+    # key: the fused-kernel run gets its own metric name
+    suffix = "_fused" if (fused and on_tpu) else ""
     _report(
-        "rn50_train_images_per_sec_per_chip", img_s, "images/s", mfu / 0.70,
+        f"rn50_train_images_per_sec_per_chip{suffix}",
+        img_s, "images/s", mfu / 0.70,
         f"rn50: step={dt*1000:.1f}ms loss={loss:.3f} mfu={mfu:.3f}",
     )
 
@@ -671,6 +682,8 @@ if __name__ == "__main__":
             kwargs["batch"] = int(a.split("=", 1)[1])
         elif a == "--remat":
             kwargs["remat"] = True
+        elif a.startswith("--fused="):
+            kwargs["fused"] = bool(int(a.split("=", 1)[1]))
         elif a.startswith("--"):
             # a typoed flag must not silently measure the wrong config
             raise SystemExit(f"unknown flag {a!r}")
@@ -683,4 +696,10 @@ if __name__ == "__main__":
         raise SystemExit(f"--dropout applies to gpt/bert, not {which!r}")
     if ("batch" in kwargs or "remat" in kwargs) and which != "bert":
         raise SystemExit("--batch/--remat apply to the bert bench")
+    if "fused" in kwargs and which != "rn50":
+        raise SystemExit("--fused applies to the rn50 bench")
+    if kwargs.get("fused") and jax.default_backend() != "tpu":
+        # a flag must not silently measure the wrong config: the fused
+        # kernel path is TPU-only (interpret mode would measure noise)
+        raise SystemExit("--fused=1 requires the TPU backend")
     benches[which](**kwargs)

@@ -7,8 +7,14 @@ halo exchange across spatially-partitioned ranks).
 
 from rocm_apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
     Bottleneck,
+    FusedBottleneck,
     SpatialBottleneck,
     halo_exchange,
 )
 
-__all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange"]
+__all__ = [
+    "Bottleneck",
+    "FusedBottleneck",
+    "SpatialBottleneck",
+    "halo_exchange",
+]

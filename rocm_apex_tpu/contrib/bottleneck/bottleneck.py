@@ -161,3 +161,112 @@ class SpatialBottleneck(nn.Module):
                 residual, use_running_average=not train
             )
         return nn.relu(y + residual)
+
+
+class FusedBottleneck(nn.Module):
+    """Training-mode bottleneck on the fused Pallas kernel chain
+    (ops/fused_bottleneck.py): BN-apply+ReLU prologues, conv-as-matmul
+    on the MXU, BN-statistics epilogues, and a merged
+    dgrad/wgrad/BN-reduction kernel per conv in backward — the TPU
+    counterpart of the reference's cudnn fused bottleneck
+    (reference: apex/contrib/bottleneck/bottleneck.py:112,
+    apex/contrib/csrc/bottleneck/bottleneck.cpp).
+
+    Stride must be 1 (stride-2 blocks use the XLA `Bottleneck`);
+    eval mode falls back to the unfused chain with running statistics.
+    """
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        from rocm_apex_tpu.ops.fused_bottleneck import bottleneck_fused
+
+        cin, cmid, cout = (
+            self.in_channels, self.bottleneck_channels, self.out_channels,
+        )
+        downsample = cin != cout
+        init = nn.initializers.he_normal()
+        ones = nn.initializers.ones
+        zeros = nn.initializers.zeros
+        w1 = self.param("conv1_kernel", init, (cin, cmid), jnp.float32)
+        w2 = self.param("conv2_kernel", init, (3, 3, cmid, cmid), jnp.float32)
+        w3 = self.param("conv3_kernel", init, (cmid, cout), jnp.float32)
+        g1 = self.param("bn1_scale", ones, (cmid,), jnp.float32)
+        b1 = self.param("bn1_bias", zeros, (cmid,), jnp.float32)
+        g2 = self.param("bn2_scale", ones, (cmid,), jnp.float32)
+        b2 = self.param("bn2_bias", zeros, (cmid,), jnp.float32)
+        g3 = self.param("bn3_scale", ones, (cout,), jnp.float32)
+        b3 = self.param("bn3_bias", zeros, (cout,), jnp.float32)
+        if downsample:
+            wd = self.param("downsample_kernel", init, (cin, cout), jnp.float32)
+            # bn4 = the downsample branch BN (flat-leaf naming keeps
+            # amp keep_batchnorm_fp32 path detection working)
+            gd = self.param("bn4_scale", ones, (cout,), jnp.float32)
+            bd = self.param("bn4_bias", zeros, (cout,), jnp.float32)
+        else:
+            wd = gd = bd = None
+
+        names = ["bn1", "bn2", "bn3"] + (["bn4"] if downsample else [])
+        dims = [cmid, cmid, cout] + ([cout] if downsample else [])
+        ras = [
+            (
+                self.variable("batch_stats", f"{nm}_mean", zeros, None, (d,)),
+                self.variable("batch_stats", f"{nm}_var", ones, None, (d,)),
+            )
+            for nm, d in zip(names, dims)
+        ]
+
+        if train:
+            xw = x.astype(self.dtype)
+            z, stats = bottleneck_fused(
+                self.epsilon, downsample, xw,
+                w1.astype(self.dtype), g1, b1,
+                w2.astype(self.dtype), g2, b2,
+                w3.astype(self.dtype), g3, b3,
+                *(
+                    (wd.astype(self.dtype), gd, bd)
+                    if downsample else (None, None, None)
+                ),
+            )
+            if not self.is_initializing():
+                m = self.momentum
+                for (ra_mu, ra_var), st in zip(ras, stats):
+                    if st is None:
+                        continue
+                    mu, var = st
+                    ra_mu.value = m * ra_mu.value + (1 - m) * mu
+                    ra_var.value = m * ra_var.value + (1 - m) * var
+            return z
+
+        # eval: the plain chain with running statistics (XLA fuses the
+        # inference-mode scale/bias into the conv epilogues fine)
+        def bn(y, g, b, ra):
+            mu, var = ra[0].value, ra[1].value
+            rs = jax.lax.rsqrt(var + self.epsilon)
+            return (y.astype(jnp.float32) - mu) * rs * g + b
+
+        xw = x.astype(self.dtype)
+        n, h, w_, _ = x.shape
+        y = xw.reshape(-1, cin) @ w1.astype(self.dtype)
+        y = jnp.maximum(bn(y, g1, b1, ras[0]), 0.0).astype(self.dtype)
+        y = jax.lax.conv_general_dilated(
+            y.reshape(n, h, w_, cmid), w2.astype(self.dtype), (1, 1),
+            "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).reshape(-1, cmid)
+        y = jnp.maximum(bn(y, g2, b2, ras[1]), 0.0).astype(self.dtype)
+        y = bn(y @ w3.astype(self.dtype), g3, b3, ras[2])
+        if downsample:
+            r = bn(
+                xw.reshape(-1, cin) @ wd.astype(self.dtype),
+                gd, bd, ras[3],
+            )
+        else:
+            r = xw.reshape(-1, cout).astype(jnp.float32)
+        z = jnp.maximum(y + r, 0.0).astype(self.dtype)
+        return z.reshape(n, h, w_, cout)

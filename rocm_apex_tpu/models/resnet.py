@@ -113,7 +113,16 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
-    """NHWC ResNet. `sync_bn_axis` switches BN to cross-replica stats."""
+    """NHWC ResNet. `sync_bn_axis` switches BN to cross-replica stats.
+
+    `fused=True` routes every stride-1 bottleneck block through the
+    fused Pallas kernel chain (ops/fused_bottleneck.py: BN-apply
+    prologues, conv-on-MXU, BN-stats epilogues, merged backward) — the
+    reference's cudnn fused-bottleneck analogue (reference:
+    apex/contrib/bottleneck/bottleneck.py:112). Stride-2 blocks and the
+    stem keep the XLA path; SyncBatchNorm and BasicBlock nets ignore
+    the flag.
+    """
 
     stage_sizes: Sequence[int]
     block: Any = Bottleneck
@@ -121,6 +130,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: jnp.dtype = jnp.float32
     sync_bn_axis: Optional[str] = None
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -132,11 +142,30 @@ class ResNet(nn.Module):
         x = norm(name="bn1")(x, use_running_average=not train)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        use_fused = (
+            self.fused
+            and self.block is Bottleneck
+            and self.sync_bn_axis is None
+        )
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
+                filters = self.num_filters * 2**i
+                if use_fused and strides == 1:
+                    from rocm_apex_tpu.contrib.bottleneck import (
+                        FusedBottleneck,
+                    )
+
+                    x = FusedBottleneck(
+                        in_channels=x.shape[-1],
+                        bottleneck_channels=filters,
+                        out_channels=filters * 4,
+                        dtype=self.dtype,
+                        name=f"layer{i + 1}_{j}",
+                    )(x, train)
+                    continue
                 x = self.block(
-                    self.num_filters * 2**i,
+                    filters,
                     strides=strides,
                     norm=norm,
                     dtype=self.dtype,

@@ -1459,7 +1459,7 @@ def _faqbd_bwd(dropout_rate, causal, scale, block_q, block_k, res, do):
 flash_attention_qkv_bias_dropout.defvjp(_faqbd_fwd, _faqbd_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_with_lse(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -1469,6 +1469,7 @@ def flash_attention_with_lse(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    compute_dbias: bool = False,
 ):
     """`flash_attention` also returning the per-row log-sum-exp.
 
@@ -1480,7 +1481,9 @@ def flash_attention_with_lse(
 
     which is what ring/context-parallel attention reduces over
     (transformer/context_parallel.py). Differentiable in q/k/v with lse
-    cotangents folded into the fused backward.
+    cotangents folded into the fused backward; like `flash_attention`,
+    bias gradients are an explicit ``compute_dbias=True`` opt-in (the
+    ring masks are constants).
     """
     return _fwd(
         q, k, v, bias, causal,
@@ -1489,16 +1492,18 @@ def flash_attention_with_lse(
     )
 
 
-def _fal_fwd(q, k, v, bias, causal, scale, block_q, block_k):
+def _fal_fwd(q, k, v, bias, causal, scale, block_q, block_k,
+             compute_dbias):
     s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     o, lse = _fwd(q, k, v, bias, causal, s, block_q, block_k)
     return (o, lse), (q, k, v, bias, o, lse)
 
 
-def _fal_bwd(causal, scale, block_q, block_k, res, cot):
+def _fal_bwd(causal, scale, block_q, block_k, compute_dbias, res, cot):
     do, dlse = cot
     s = scale if scale is not None else 1.0 / np.sqrt(res[0].shape[-1])
-    return _bwd(causal, s, block_q, block_k, res, do, dlse=dlse)
+    return _bwd(causal, s, block_q, block_k, res, do, dlse=dlse,
+                compute_dbias=compute_dbias)
 
 
 flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)

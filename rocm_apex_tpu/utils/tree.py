@@ -40,9 +40,13 @@ def path_str(path) -> str:
 def is_batchnorm_path(path) -> bool:
     # Match whole path segments (or a numbered segment like "bn1" /
     # "batchnorm_0"), not raw substrings — "subnet" must not match "bn".
+    # Flat-leaf modules (FusedBottleneck) name BN params "bn1_scale" /
+    # "bn4_bias"; the second alternative covers those without matching
+    # conv leaves like "conv1_kernel" or "downsample_kernel".
     segments = path_str(path).split("/")
     return any(
         re.fullmatch(tok + r"_?\d*", seg)
+        or re.fullmatch(tok + r"_?\d*_(scale|bias|mean|var)", seg)
         for seg in segments
         for tok in _BN_PATH_TOKENS
     )

@@ -1,0 +1,205 @@
+"""Fused bottleneck kernels vs the composed conv/BN/ReLU chain.
+
+The fused block (ops/fused_bottleneck.py) re-expresses the reference's
+cudnn fused bottleneck (reference: apex/contrib/bottleneck/
+bottleneck.py:112, csrc/bottleneck/bottleneck.cpp) as Pallas kernels
+with BN-apply prologues and BN-stats epilogues, plus a hand-chained
+backward. Every output and every gradient is checked against the stock
+XLA composition in training mode (batch statistics), with and without
+the 1x1 downsample branch, and through the flax module + ResNet
+integration. Kernels run in Pallas interpret mode on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _helpers import assert_close
+
+from rocm_apex_tpu.contrib.bottleneck import FusedBottleneck
+from rocm_apex_tpu.models.resnet import ResNet, Bottleneck
+from rocm_apex_tpu.ops.fused_bottleneck import (
+    bn_coeffs,
+    bottleneck_fused,
+    conv1x1_bn_act,
+    conv3x3_bn_act,
+)
+
+EPS = 1e-5
+
+
+def bn_train(y, g, b):
+    mu = y.mean(axis=0)
+    var = ((y - mu) ** 2).mean(axis=0)
+    return (y - mu) * jax.lax.rsqrt(var + EPS) * g + b
+
+
+def ref_block(x, w1, g1, b1, w2, g2, b2, w3, g3, b3,
+              wd=None, gd=None, bd=None):
+    n, h, w_, c = x.shape
+    m = n * h * w_
+    x2 = x.reshape(m, c)
+    u1 = jnp.maximum(bn_train(x2 @ w1, g1, b1), 0.0)
+    cmid = w1.shape[-1]
+    y2 = jax.lax.conv_general_dilated(
+        u1.reshape(n, h, w_, cmid), w2, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ).reshape(m, cmid)
+    u2 = jnp.maximum(bn_train(y2, g2, b2), 0.0)
+    o3 = bn_train(u2 @ w3, g3, b3)
+    r = bn_train(x2 @ wd, gd, bd) if wd is not None else x2
+    return jnp.maximum(o3 + r, 0.0).reshape(n, h, w_, -1)
+
+
+def _params(key, cin, cmid, cout, downsample):
+    ks = jax.random.split(key, 13)
+    p = [
+        jax.random.normal(ks[0], (cin, cmid)) * 0.2,
+        jax.random.normal(ks[1], (cmid,)) * 0.1 + 1.0,
+        jax.random.normal(ks[2], (cmid,)) * 0.1,
+        jax.random.normal(ks[3], (3, 3, cmid, cmid)) * 0.2,
+        jax.random.normal(ks[4], (cmid,)) * 0.1 + 1.0,
+        jax.random.normal(ks[5], (cmid,)) * 0.1,
+        jax.random.normal(ks[6], (cmid, cout)) * 0.2,
+        jax.random.normal(ks[7], (cout,)) * 0.1 + 1.0,
+        jax.random.normal(ks[8], (cout,)) * 0.1,
+    ]
+    if downsample:
+        p += [
+            jax.random.normal(ks[9], (cin, cout)) * 0.2,
+            jax.random.normal(ks[10], (cout,)) * 0.1 + 1.0,
+            jax.random.normal(ks[11], (cout,)) * 0.1,
+        ]
+    return p
+
+
+class TestKernels:
+    def test_conv1x1_stats(self):
+        k = jax.random.PRNGKey(0)
+        x = jax.random.normal(k, (64, 16))
+        w = jax.random.normal(jax.random.PRNGKey(1), (16, 32)) * 0.3
+        y, (s1, s2) = conv1x1_bn_act(x, w, stats=True)
+        assert_close(np.asarray(y), np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+        assert_close(np.asarray(s1), np.asarray((x @ w).sum(0)),
+                     rtol=1e-4, atol=1e-4)
+        assert_close(np.asarray(s2), np.asarray(((x @ w) ** 2).sum(0)),
+                     rtol=1e-4, atol=1e-4)
+
+    def test_conv1x1_prologue(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 16))
+        w = jax.random.normal(jax.random.PRNGKey(1), (16, 32)) * 0.3
+        a = jnp.full((16,), 1.3)
+        b = jnp.full((16,), -0.2)
+        y, _ = conv1x1_bn_act(x, w, a, b, stats=False)
+        u = jnp.maximum(x * a + b, 0.0)
+        assert_close(np.asarray(y), np.asarray(u @ w), rtol=1e-5, atol=1e-5)
+
+    def test_conv3x3_same(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 5, 8))
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 8, 16)) * 0.3
+        y, (s1, _) = conv3x3_bn_act(x, w, stats=True)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        assert_close(np.asarray(y), np.asarray(ref), rtol=1e-4, atol=1e-4)
+        assert_close(np.asarray(s1), np.asarray(ref.sum((0, 1, 2))),
+                     rtol=1e-4, atol=1e-4)
+
+    def test_bn_coeffs(self):
+        y = jax.random.normal(jax.random.PRNGKey(0), (128, 8)) * 2 + 1
+        sums = (y.sum(0), (y * y).sum(0))
+        g = jnp.full((8,), 1.5)
+        b = jnp.full((8,), 0.3)
+        mean, rs, scale, bias = bn_coeffs(sums, 128, g, b, EPS)
+        ref = bn_train(y, g, b)
+        assert_close(np.asarray(y * scale + bias), np.asarray(ref),
+                     rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("downsample", [True, False])
+class TestBlock:
+    def _setup(self, downsample):
+        cin = 16 if downsample else 32
+        p = _params(jax.random.PRNGKey(7), cin, 8, 32, downsample)
+        x = jax.random.normal(jax.random.PRNGKey(8), (2, 8, 8, cin))
+        return x, p
+
+    def test_forward(self, downsample):
+        x, p = self._setup(downsample)
+        z, stats = bottleneck_fused(EPS, downsample, x, *p)
+        assert_close(np.asarray(z), np.asarray(ref_block(x, *p)),
+                     rtol=2e-4, atol=2e-4)
+        # batch stats of bn1 match the raw conv1 output's statistics
+        y1 = x.reshape(-1, x.shape[-1]) @ p[0]
+        mu1, var1 = stats[0]
+        assert_close(np.asarray(mu1), np.asarray(y1.mean(0)),
+                     rtol=1e-4, atol=1e-4)
+        assert_close(np.asarray(var1), np.asarray(y1.var(0)),
+                     rtol=1e-4, atol=1e-4)
+
+    def test_gradients(self, downsample):
+        x, p = self._setup(downsample)
+        ct = jax.random.normal(jax.random.PRNGKey(9), (2, 8, 8, 32))
+        argnums = tuple(range(len(p) + 1))
+        gf = jax.grad(
+            lambda x, *p: jnp.sum(
+                bottleneck_fused(EPS, downsample, x, *p)[0] * ct
+            ),
+            argnums=argnums,
+        )(x, *p)
+        gr = jax.grad(
+            lambda x, *p: jnp.sum(ref_block(x, *p) * ct),
+            argnums=argnums,
+        )(x, *p)
+        for a, b in zip(gf, gr):
+            scale = float(jnp.max(jnp.abs(b))) + 1e-8
+            err = float(jnp.max(jnp.abs(a - b)))
+            assert err / scale < 2e-3, (err, scale)
+
+
+class TestModule:
+    def test_module_matches_unfused_and_updates_running_stats(self):
+        mod = FusedBottleneck(
+            in_channels=16, bottleneck_channels=8, out_channels=32,
+            dtype=jnp.float32,
+        )
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 16))
+        vs = mod.init(jax.random.PRNGKey(1), x)
+        z, mut = mod.apply(vs, x, mutable=["batch_stats"])
+        p = vs["params"]
+        ref = ref_block(
+            x,
+            p["conv1_kernel"], p["bn1_scale"], p["bn1_bias"],
+            p["conv2_kernel"], p["bn2_scale"], p["bn2_bias"],
+            p["conv3_kernel"], p["bn3_scale"], p["bn3_bias"],
+            p["downsample_kernel"], p["bn4_scale"],
+            p["bn4_bias"],
+        )
+        assert_close(np.asarray(z), np.asarray(ref), rtol=2e-4, atol=2e-4)
+        # running stats moved toward the batch stats (momentum 0.9)
+        y1 = x.reshape(-1, 16) @ p["conv1_kernel"]
+        got = mut["batch_stats"]["bn1_mean"]
+        assert_close(np.asarray(got), np.asarray(0.1 * y1.mean(0)),
+                     rtol=1e-3, atol=1e-4)
+
+        # eval mode runs the running-stat chain without error
+        vs2 = {"params": p, "batch_stats": mut["batch_stats"]}
+        ze = mod.apply(vs2, x, train=False)
+        assert ze.shape == z.shape
+        assert np.isfinite(np.asarray(ze)).all()
+
+    def test_resnet_fused_flag(self):
+        model = ResNet(
+            stage_sizes=(1, 1), block=Bottleneck, num_classes=10,
+            num_filters=8, dtype=jnp.float32, fused=True,
+        )
+        x = jnp.ones((1, 32, 32, 3))
+        vs = model.init(jax.random.PRNGKey(0), x)
+        # stride-1 block fused, stride-2 block on the XLA path
+        assert "conv1_kernel" in vs["params"]["layer1_0"]
+        assert "conv1" in vs["params"]["layer2_0"]
+        logits, _ = model.apply(vs, x, mutable=["batch_stats"])
+        assert logits.shape == (1, 10)
+        assert np.isfinite(np.asarray(logits)).all()
