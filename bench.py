@@ -62,6 +62,16 @@ def peak_flops_per_chip() -> float:
     return 1e12
 
 
+def _dropout_rng0(dropout: float, on_tpu: bool):
+    # dropout keys use the TPU hardware RNG ('rbg'): threefry mask
+    # generation is VPU-expensive (measured as most of the dropout-on
+    # step overhead — BASELINE.md round-4 rows); rbg is the TPU-native
+    # PRNG for exactly this
+    if dropout > 0.0 and on_tpu:
+        return jax.random.key(2, impl="rbg")
+    return jax.random.PRNGKey(2)
+
+
 def _report(metric, value, unit, vs_baseline, extra=""):
     print(extra, file=sys.stderr)
     print(
@@ -245,7 +255,9 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
         )
         return carry, losses
 
-    carry, losses = runN(params, opt_state, jax.random.PRNGKey(2))
+    carry, losses = runN(
+        params, opt_state, _dropout_rng0(dropout, on_tpu)
+    )
     float(losses[-1])
     t0 = time.perf_counter()
     carry, losses = runN(*carry)
@@ -556,8 +568,18 @@ def bench_ln():
     )
 
 
-def main(dropout: float = 0.0):
+def main(dropout: float = 0.0, seq: int = 0, batch: int = 0,
+         remat: bool = False):
     on_tpu = jax.default_backend() == "tpu"
+    default_seq = SEQ if on_tpu else 128
+    seq = min(seq or default_seq, default_seq if not on_tpu else 1 << 20)
+    # long-context configs shrink the batch to fit and pay ITERS down
+    # (the S^2 attention makes each step long enough to amortize RTT)
+    default_batch = (
+        BATCH if seq <= 2048 else max(1, BATCH * SEQ // (4 * seq))
+    )
+    batch = batch or default_batch
+    iters = ITERS if seq <= 2048 else max(8, ITERS * SEQ // seq)
     # head_dim = hidden/heads = 128 = the MXU lane width. hd=64 pads
     # every attention operand to 128 lanes and wastes half the MXU —
     # measured 27 ms/step slower on this exact model. TPU-first model
@@ -567,24 +589,25 @@ def main(dropout: float = 0.0):
         hidden_size=1024 if on_tpu else 128,
         num_layers=8 if on_tpu else 2,
         num_attention_heads=8 if on_tpu else 4,
-        max_position_embeddings=SEQ if on_tpu else 128,
+        max_position_embeddings=seq if on_tpu else 128,
         hidden_dropout=dropout,
         attention_dropout=dropout,
         tensor_parallel_size=1,
+        checkpoint_activations=remat,
     )
-    seq = min(SEQ, cfg.max_position_embeddings)
+    seq = min(seq, cfg.max_position_embeddings)
 
     model = GPTModel(cfg)
     opt = MixedPrecisionAdam(1e-4, weight_decay=0.01)
     scaler = LossScaler(loss_scale="dynamic")
 
     key = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(key, (BATCH, seq), 0, cfg.vocab_size)
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
     params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
     state = opt.init(params32)
     sstate = scaler.init()
-    rng0 = jax.random.PRNGKey(2)
+    rng0 = _dropout_rng0(dropout, on_tpu)
 
     def one_step(carry, _):
         state, sstate, rng = carry
@@ -613,7 +636,7 @@ def main(dropout: float = 0.0):
         # unroll=2 halves the while-loop bookkeeping between steps
         # (measured -0.9 ms/step) at the cost of one extra body compile
         (state, sstate, rng), losses = jax.lax.scan(
-            one_step, (state, sstate, rng), None, length=ITERS, unroll=2
+            one_step, (state, sstate, rng), None, length=iters, unroll=2
         )
         return state, sstate, rng, losses
 
@@ -623,9 +646,9 @@ def main(dropout: float = 0.0):
     t0 = time.perf_counter()
     state, sstate, rng0, losses = runN(state, sstate, rng0)
     loss = float(losses[-1])
-    dt = (time.perf_counter() - t0) / ITERS
+    dt = (time.perf_counter() - t0) / iters
 
-    tokens_per_sec = BATCH * seq / dt
+    tokens_per_sec = batch * seq / dt
     n_params = sum(
         int(x.size) for x in jax.tree_util.tree_leaves(params32)
     ) - cfg.vocab_size * cfg.hidden_size
@@ -638,22 +661,31 @@ def main(dropout: float = 0.0):
     # credited at zero; BASELINE.md "MFU crediting" documents both
     # numbers and the driver JSON carries the head-inclusive one).
     model_flops = (
-        6.0 * n_params * BATCH * seq
-        + 12.0 * cfg.num_layers * BATCH * seq * seq * cfg.hidden_size
-        + 6.0 * BATCH * seq * cfg.hidden_size * cfg.vocab_size
+        6.0 * n_params * batch * seq
+        + 12.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
+        + 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size
     )
     mfu = (model_flops / dt) / peak_flops_per_chip()
     mfu_sans_head = (
-        (model_flops - 6.0 * BATCH * seq * cfg.hidden_size * cfg.vocab_size)
+        (model_flops - 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size)
         / dt
     ) / peak_flops_per_chip()
+    # the driver's BASELINE series must never mix configs under one
+    # key: every non-default knob lands in the metric name
     suffix = "_dropout" if dropout > 0.0 else ""
+    if seq != default_seq:
+        suffix += f"_s{seq}"
+    if batch != default_batch:
+        suffix += f"_b{batch}"
+    if remat:
+        suffix += "_remat"
     _report(
         f"gpt_train_tokens_per_sec_per_chip{suffix}", tokens_per_sec,
         "tokens/s", mfu / 0.70,
         f"step={dt*1000:.1f}ms loss={loss:.4f} mfu={mfu:.3f} "
         f"(sans-head crediting: {mfu_sans_head:.3f}) "
-        f"dropout={dropout} backend={jax.default_backend()}",
+        f"dropout={dropout} b={batch} s={seq} remat={remat} "
+        f"backend={jax.default_backend()}",
     )
 
 
@@ -680,6 +712,8 @@ if __name__ == "__main__":
             kwargs["dropout"] = float(a.split("=", 1)[1])
         elif a.startswith("--batch="):
             kwargs["batch"] = int(a.split("=", 1)[1])
+        elif a.startswith("--seq="):
+            kwargs["seq"] = int(a.split("=", 1)[1])
         elif a == "--remat":
             kwargs["remat"] = True
         elif a.startswith("--fused="):
@@ -694,8 +728,12 @@ if __name__ == "__main__":
         )
     if "dropout" in kwargs and which not in ("gpt", "bert"):
         raise SystemExit(f"--dropout applies to gpt/bert, not {which!r}")
-    if ("batch" in kwargs or "remat" in kwargs) and which != "bert":
-        raise SystemExit("--batch/--remat apply to the bert bench")
+    if ("batch" in kwargs or "remat" in kwargs) and which not in (
+        "gpt", "bert"
+    ):
+        raise SystemExit("--batch/--remat apply to the gpt/bert benches")
+    if "seq" in kwargs and which != "gpt":
+        raise SystemExit("--seq applies to the gpt bench")
     if "fused" in kwargs and which != "rn50":
         raise SystemExit("--fused applies to the rn50 bench")
     if kwargs.get("fused") and jax.default_backend() != "tpu":
