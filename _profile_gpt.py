@@ -1,8 +1,11 @@
 """Dev driver: device-profile the flagship GPT bench step and print the
 per-fusion breakdown (the BASELINE.md bucket tables come from this).
 
-Usage: python _profile_gpt.py [iters] — runs bench.py's exact step under
-jax.profiler.trace and aggregates with profiler.op_stats.
+Usage: python _profile_gpt.py [iters] [--dropout=R] — runs bench.py's
+exact step under jax.profiler.trace and aggregates with
+profiler.op_stats.  --dropout=0.1 profiles the TRAINING config
+(in-kernel attention dropout + rbg hidden-dropout keys), matching
+``python bench.py --dropout=0.1``.
 """
 
 import sys
@@ -17,7 +20,12 @@ from rocm_apex_tpu import profiler
 
 BATCH = 16
 SEQ = 1024
-ITERS = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+_pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+ITERS = int(_pos[0]) if _pos else 20
+DROPOUT = 0.0
+for _a in sys.argv[1:]:
+    if _a.startswith("--dropout="):
+        DROPOUT = float(_a.split("=", 1)[1])
 
 
 def main():
@@ -27,8 +35,8 @@ def main():
         num_layers=8,
         num_attention_heads=8,
         max_position_embeddings=SEQ,
-        hidden_dropout=0.0,
-        attention_dropout=0.0,
+        hidden_dropout=DROPOUT,
+        attention_dropout=DROPOUT,
         tensor_parallel_size=1,
     )
     model = GPTModel(cfg)
@@ -41,12 +49,21 @@ def main():
     params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
     state = opt.init(params32)
     sstate = scaler.init()
+    if DROPOUT > 0.0 and jax.default_backend() == "tpu":
+        rng0 = jax.random.key(2, impl="rbg")
+    else:
+        rng0 = jax.random.PRNGKey(2)
 
     def one_step(carry, _):
-        state, sstate = carry
+        state, sstate, rng = carry
+        rng, step_rng = jax.random.split(rng)
 
         def loss_fn(params):
-            losses = model.apply(params, tokens, labels=labels)
+            losses = model.apply(
+                params, tokens, labels=labels,
+                deterministic=DROPOUT == 0.0,
+                rngs={"dropout": step_rng} if DROPOUT > 0.0 else None,
+            )
             return gpt_loss_fn(losses) * scaler.loss_scale(sstate)
 
         scaled, grads = jax.value_and_grad(loss_fn)(state.model)
@@ -55,12 +72,12 @@ def main():
             state, grads, grad_scale=inv_scale
         )
         sstate2, _ = scaler.update(sstate, found_inf)
-        return (state2, sstate2), scaled * inv_scale
+        return (state2, sstate2, rng), scaled * inv_scale
 
     @jax.jit
     def runN(state, sstate):
-        (state, sstate), losses = jax.lax.scan(
-            one_step, (state, sstate), None, length=ITERS, unroll=2
+        (state, sstate, _), losses = jax.lax.scan(
+            one_step, (state, sstate, rng0), None, length=ITERS, unroll=2
         )
         return state, sstate, losses
 

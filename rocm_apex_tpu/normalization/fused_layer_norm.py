@@ -113,24 +113,41 @@ class FusedLayerNorm(nn.Module):
 
 
 def mixed_dtype_fused_layer_norm_residual_affine(
-    x, delta, weight, bias, normalized_shape: Shape, eps: float = 1e-5
+    x, delta, weight, bias, normalized_shape: Shape, eps: float = 1e-5,
+    dropout_rate: float = 0.0, dropout_seed=None,
 ):
     """(LN(x+delta), x+delta) fused in one kernel; LN output follows
-    the weight dtype (the mixed contract), the stream follows x."""
+    the weight dtype (the mixed contract), the stream follows x.
+    ``dropout_rate > 0`` applies in-kernel dropout to the DELTA before
+    the add (TPU hardware PRNG seeded by the int32 scalar
+    ``dropout_seed``; mask regenerated in backward, never stored —
+    ops/layer_norm.py `layer_norm_residual_dropout_affine`)."""
     if x.shape != delta.shape:
         raise ValueError(
             f"residual/delta shapes differ: {x.shape} vs {delta.shape}"
         )
     x2d, orig = _to_2d(x, normalized_shape)
     d2d, _ = _to_2d(delta, normalized_shape)
-    y, s = _ln_ops.layer_norm_residual_affine(
-        x2d,
-        d2d,
-        weight.reshape(-1),
-        bias.reshape(-1),
-        eps,
-        weight.dtype,
-    )
+    if dropout_rate > 0.0:
+        y, s = _ln_ops.layer_norm_residual_dropout_affine(
+            x2d,
+            d2d,
+            weight.reshape(-1),
+            bias.reshape(-1),
+            dropout_seed,
+            dropout_rate,
+            eps,
+            weight.dtype,
+        )
+    else:
+        y, s = _ln_ops.layer_norm_residual_affine(
+            x2d,
+            d2d,
+            weight.reshape(-1),
+            bias.reshape(-1),
+            eps,
+            weight.dtype,
+        )
     return y.reshape(orig), s.reshape(orig)
 
 
@@ -142,14 +159,18 @@ class MixedFusedLayerNorm(nn.Module):
     ``residual``: when given, the residual add fuses into the kernel —
     the call returns ``(LN(residual + x), residual + x)`` so the new
     stream never costs a standalone HBM pass (no reference analogue;
-    the CUDA build leaves the add to torch)."""
+    the CUDA build leaves the add to torch). ``dropout_rate``/
+    ``dropout_seed`` additionally drop the incoming ``x`` (the delta)
+    inside the kernel before the add — hidden dropout with no mask
+    tensor in HBM (TPU-only; see ops/layer_norm.py)."""
 
     normalized_shape: Shape
     eps: float = 1e-5
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, residual=None):
+    def __call__(self, x, residual=None, dropout_rate: float = 0.0,
+                 dropout_seed=None):
         shape = _normalize_shape(self.normalized_shape)
         weight = self.param(
             "weight", nn.initializers.ones_init(), shape, self.param_dtype
@@ -159,6 +180,11 @@ class MixedFusedLayerNorm(nn.Module):
         )
         if residual is not None:
             return mixed_dtype_fused_layer_norm_residual_affine(
-                residual, x, weight, bias, shape, self.eps
+                residual, x, weight, bias, shape, self.eps,
+                dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+            )
+        if dropout_rate > 0.0:
+            raise ValueError(
+                "in-kernel dropout rides the residual form; pass residual="
             )
         return mixed_dtype_fused_layer_norm_affine(x, weight, bias, shape, self.eps)

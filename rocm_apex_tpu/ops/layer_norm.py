@@ -20,6 +20,19 @@ cotangent ds into the dx pass — the transformer's residual adds are
 otherwise standalone HBM round trips XLA cannot fuse into a custom
 call. (No reference analogue; the CUDA build leaves the add to torch.)
 
+`layer_norm_residual_dropout_affine` additionally applies DROPOUT to
+the delta inside the same kernel (s = x + keep·delta/(1−p)), with the
+keep mask drawn from the TPU hardware PRNG and REGENERATED in the
+backward from the same seed — the flash-dropout recompute trick
+(ops/flash_attention.py `_keep_mask`, shared so forward and backward
+bits cannot desynchronize). No mask tensor ever reaches HBM: the
+standalone rbg-dropout path costs ~3 ms/step on the 134M bench in
+u32[b,s,h] mask saves for backward + generation passes (round-5
+profile), all of which this kernel removes. TPU-only (the in-kernel
+PRNG has no interpret-mode lowering); callers gate on `on_tpu()`.
+The reference applies hidden dropout inside its fused kernels the
+same way (apex/contrib/csrc/multihead_attn/dropout_add variants).
+
 All math is fp32 in-register; output dtype follows the input (or the
 weight dtype for the mixed variant, handled by the module layer).
 """
@@ -29,6 +42,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from rocm_apex_tpu.ops._pallas import kernel_dtype, pad_rows, pallas_call, row_block
@@ -38,6 +52,7 @@ __all__ = [
     "layer_norm",
     "layer_norm_affine",
     "layer_norm_residual_affine",
+    "layer_norm_residual_dropout_affine",
 ]
 
 
@@ -55,17 +70,28 @@ def _pad_rows(x, block: int):
 # ---------------------------------------------------------------------------
 
 
-def _ln_fwd_kernel(affine, residual, eps, x_ref, *refs):
+def _ln_fwd_kernel(affine, residual, rate, eps, x_ref, *refs):
     refs = list(refs)
     r_ref = refs.pop(0) if residual else None
     if affine:
         g_ref, b_ref = refs.pop(0), refs.pop(0)
+    seed_ref = refs.pop(0) if rate > 0.0 else None
     y_ref = refs.pop(0)
     s_ref = refs.pop(0) if residual else None
     mu_ref, rs_ref = refs
     x = x_ref[...].astype(jnp.float32)
     if residual:
-        x = x + r_ref[...].astype(jnp.float32)
+        d = r_ref[...].astype(jnp.float32)
+        if rate > 0.0:
+            # in-kernel dropout on the delta; the backward regenerates
+            # the identical bits from (seed, row-block) — no mask in HBM
+            from rocm_apex_tpu.ops.flash_attention import _keep_mask
+
+            i = pl.program_id(0)
+            zero = jnp.int32(0)
+            keep = _keep_mask(seed_ref, rate, i, zero, zero, d.shape)
+            d = jnp.where(keep, d * (1.0 / (1.0 - rate)), 0.0)
+        x = x + d
         s_ref[...] = x.astype(s_ref.dtype)
     mu = jnp.mean(x, axis=1, keepdims=True)
     xc = x - mu
@@ -79,9 +105,11 @@ def _ln_fwd_kernel(affine, residual, eps, x_ref, *refs):
     rs_ref[...] = rs
 
 
-def _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype):
+def _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype,
+                 rate=0.0, seed=None):
     """Shared forward: plain LN when delta2d is None, fused residual
-    form otherwise (extra s = x + delta output)."""
+    form otherwise (extra s = x + delta output); rate > 0 adds
+    in-kernel dropout on the delta (TPU only)."""
     rows0, hidden = x2d.shape
     out_dtype = out_dtype or x2d.dtype
     affine = weight is not None
@@ -107,6 +135,11 @@ def _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype):
             bias.reshape(1, hidden).astype(kernel_dtype(bias.dtype)),
         ]
         in_specs += [gb_spec, gb_spec]
+    if rate > 0.0:
+        from jax.experimental.pallas import tpu as pltpu
+
+        ins.append(jnp.asarray(seed, jnp.int32).reshape(1))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     out_specs = [row_spec]
     out_shape = [jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(out_dtype))]
@@ -122,7 +155,7 @@ def _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype):
     ]
 
     outs = pallas_call(
-        functools.partial(_ln_fwd_kernel, affine, residual, eps),
+        functools.partial(_ln_fwd_kernel, affine, residual, rate, eps),
         grid=(grid,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -165,14 +198,17 @@ def layer_norm_fwd(
 # ---------------------------------------------------------------------------
 
 
-def _ln_bwd_kernel(affine, has_ds, x_ref, dy_ref, *refs):
+def _ln_bwd_kernel(affine, has_ds, rate, x_ref, dy_ref, *refs):
     refs = list(refs)
     ds_ref = refs.pop(0) if has_ds else None
     mu_ref, rs_ref = refs.pop(0), refs.pop(0)
+    seed_ref = refs.pop(0) if rate > 0.0 else None
     if affine:
-        g_ref, dx_ref, dg_ref, db_ref = refs
-    else:
-        (dx_ref,) = refs
+        g_ref = refs.pop(0)
+    dx_ref = refs.pop(0)
+    dd_ref = refs.pop(0) if rate > 0.0 else None
+    if affine:
+        dg_ref, db_ref = refs
     x = x_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
     mu = mu_ref[...]
@@ -199,9 +235,25 @@ def _ln_bwd_kernel(affine, has_ds, x_ref, dy_ref, *refs):
         # the residual stream's cotangent rides the same pass
         dx = dx + ds_ref[...].astype(jnp.float32)
     dx_ref[...] = dx.astype(dx_ref.dtype)
+    if rate > 0.0:
+        # regenerate the forward's keep bits (same seed, same block
+        # coords, same shared _keep_mask) and emit the delta cotangent
+        from rocm_apex_tpu.ops.flash_attention import _keep_mask
+
+        i = pl.program_id(0)
+        zero = jnp.int32(0)
+        keep = _keep_mask(seed_ref, rate, i, zero, zero, dx.shape)
+        dd = jnp.where(keep, dx * (1.0 / (1.0 - rate)), 0.0)
+        dd_ref[...] = dd.astype(dd_ref.dtype)
 
 
-def _layer_norm_bwd(affine, eps, res, dy, ds=None):
+def _layer_norm_bwd(affine, eps, res, dy, ds=None, rate=0.0, seed=None):
+    if rate > 0.0 and not affine:
+        # the rate>0 unpacking below is affine-only; silently dropping
+        # the dd output would lose the delta gradient
+        raise NotImplementedError(
+            "in-kernel dropout backward is only wired for the affine form"
+        )
     x2d, weight, mu, rs = res
     rows0, hidden = x2d.shape
     has_ds = ds is not None
@@ -226,8 +278,18 @@ def _layer_norm_bwd(affine, eps, res, dy, ds=None):
         in_specs.append(row_spec)
     ins += [mu_p, rs_p]
     in_specs += [col_spec, col_spec]
+    if rate > 0.0:
+        from jax.experimental.pallas import tpu as pltpu
+
+        ins.append(jnp.asarray(seed, jnp.int32).reshape(1))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     out_specs = [row_spec]
     out_shape = [jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(x2d.dtype))]
+    if rate > 0.0:
+        out_specs.append(row_spec)
+        out_shape.append(
+            jax.ShapeDtypeStruct((rows, hidden), kernel_dtype(x2d.dtype))
+        )
     if affine:
         ins.append(weight.reshape(1, hidden).astype(kernel_dtype(weight.dtype)))
         in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0)))
@@ -241,12 +303,22 @@ def _layer_norm_bwd(affine, eps, res, dy, ds=None):
         ]
 
     outs = pallas_call(
-        functools.partial(_ln_bwd_kernel, affine, has_ds),
+        functools.partial(_ln_bwd_kernel, affine, has_ds, rate),
         grid=(grid,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
     )(*ins)
+    if affine and rate > 0.0:
+        dx, dd, dg_part, db_part = outs
+        dg = dg_part.sum(axis=0).astype(weight.dtype)
+        db = db_part.sum(axis=0).astype(weight.dtype)
+        return (
+            dx[:rows0].astype(x2d.dtype),
+            dd[:rows0].astype(x2d.dtype),
+            dg,
+            db,
+        )
     if affine:
         dx, dg_part, db_part = outs
         dg = dg_part.sum(axis=0).astype(weight.dtype)
@@ -335,3 +407,50 @@ def _lnr_bwd(eps, out_dtype, res, cts):
 
 
 layer_norm_residual_affine.defvjp(_lnr_fwd, _lnr_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def layer_norm_residual_dropout_affine(
+    x2d, delta2d, weight, bias, seed, rate, eps, out_dtype=None
+):
+    """(LN(x + dropout(delta)), x + dropout(delta)) in ONE kernel.
+
+    Like `layer_norm_residual_affine` but the delta passes through
+    dropout (keep prob 1−rate, scaled 1/(1−rate)) INSIDE the kernel:
+    the keep mask comes from the TPU hardware PRNG seeded by
+    (``seed``, row-block) and is regenerated bit-identically in the
+    backward — no mask tensor is stored (see module docstring).
+    ``seed`` is an int32 scalar; draw one per dropout site.
+    TPU-only: the in-kernel PRNG has no interpret-mode lowering.
+    """
+    y, s, _, _ = _ln_fwd_impl(
+        x2d, delta2d, weight, bias, eps, out_dtype, rate=rate, seed=seed
+    )
+    return y, s
+
+
+def _lnrd_fwd(x2d, delta2d, weight, bias, seed, rate, eps, out_dtype):
+    y, s, mu, rs = _ln_fwd_impl(
+        x2d, delta2d, weight, bias, eps, out_dtype, rate=rate, seed=seed
+    )
+    d_witness = jnp.zeros((0,), delta2d.dtype)
+    return (y, s), (s, weight, mu, rs, seed, d_witness)
+
+
+def _lnrd_bwd(rate, eps, out_dtype, res, cts):
+    dy, ds = cts
+    s, weight, mu, rs, seed, d_witness = res
+    dx, dd, dg, db = _layer_norm_bwd(
+        True, eps, (s, weight, mu, rs), dy, ds=ds, rate=rate, seed=seed
+    )
+    seed_ct = np.zeros((), jax.dtypes.float0)
+    return (
+        dx.astype(s.dtype),
+        dd.astype(d_witness.dtype),
+        dg,
+        db,
+        seed_ct,
+    )
+
+
+layer_norm_residual_dropout_affine.defvjp(_lnrd_fwd, _lnrd_bwd)

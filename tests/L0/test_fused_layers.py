@@ -326,3 +326,84 @@ class TestXentropy:
         )(logits)
         gr = jax.grad(lambda l: jnp.sum(ref_smoothed_ce(l, labels, smoothing)))(logits)
         assert_close(np.asarray(gf), np.asarray(gr), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="in-kernel dropout uses the TPU PRNG (no interpret lowering)",
+)
+class TestLayerNormResidualDropoutTPU:
+    """Runs only on real TPU (APEX_TPU_TEST_PLATFORM=axon).
+
+    The fused residual-LN-dropout kernel (ops/layer_norm.py
+    `layer_norm_residual_dropout_affine`) regenerates its keep mask
+    from the seed in backward; the mask is recovered from the forward's
+    stream output and the whole VJP is checked against the explicitly
+    composed chain using that same mask."""
+
+    def _setup(self):
+        rows, hidden = 1000, 512  # deliberately not a block multiple
+        x = jax.random.normal(jax.random.PRNGKey(0), (rows, hidden))
+        # delta magnitudes bounded away from 0: an element with
+        # |delta|/(1-rate) under ulp(|x|) would be absorbed by the
+        # in-kernel fp32 add, making the s - x mask recovery ambiguous
+        d = jax.random.normal(jax.random.PRNGKey(1), (rows, hidden))
+        delta = jnp.sign(d) * (0.1 + jnp.abs(d))
+        w = 1.0 + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (hidden,))
+        b = 0.1 * jax.random.normal(jax.random.PRNGKey(3), (hidden,))
+        return x, delta, w, b
+
+    def test_mask_statistics_and_determinism(self):
+        x, delta, w, b = self._setup()
+        rate, seed = 0.25, jnp.int32(77)
+        _, s = ln_ops.layer_norm_residual_dropout_affine(
+            x, delta, w, b, seed, rate, 1e-5
+        )
+        d_applied = np.asarray(s - x)
+        keep = np.abs(d_applied) > 0
+        assert abs(keep.mean() - (1 - rate)) < 0.02
+        # atol: the recovery s - x re-rounds near-zero delta elements
+        np.testing.assert_allclose(
+            d_applied[keep],
+            (np.asarray(delta) / (1 - rate))[keep],
+            rtol=1e-5,
+            atol=1e-6,
+        )
+        _, s2 = ln_ops.layer_norm_residual_dropout_affine(
+            x, delta, w, b, seed, rate, 1e-5
+        )
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(s2))
+
+    def test_vjp_matches_explicit_composition(self):
+        x, delta, w, b = self._setup()
+        rate, seed, eps = 0.1, jnp.int32(12345), 1e-5
+
+        def fused(x, delta, w, b):
+            return ln_ops.layer_norm_residual_dropout_affine(
+                x, delta, w, b, seed, rate, eps
+            )
+
+        _, s = fused(x, delta, w, b)
+        keep = jnp.abs(s - x) > 0  # backward must regenerate THESE bits
+
+        def explicit(x, delta, w, b):
+            d = jnp.where(keep, delta / (1 - rate), 0.0)
+            return ln_ops.layer_norm_residual_affine(x, d, w, b, eps)
+
+        cy = jax.random.normal(jax.random.PRNGKey(4), s.shape)
+        cs = jax.random.normal(jax.random.PRNGKey(5), s.shape)
+
+        def grads(f):
+            def g(x, delta, w, b):
+                y, s2 = f(x, delta, w, b)
+                return jnp.sum(y * cy) + jnp.sum(s2 * cs)
+
+            return jax.grad(g, (0, 1, 2, 3))(x, delta, w, b)
+
+        for name, a, c in zip(
+            ("dx", "ddelta", "dw", "db"), grads(fused), grads(explicit)
+        ):
+            rel = float(
+                jnp.max(jnp.abs(a - c)) / (jnp.max(jnp.abs(c)) + 1e-12)
+            )
+            assert rel < 2e-5, (name, rel)
