@@ -15,7 +15,18 @@ axis inside `shard_map`, applied to the packed dtype-group buffers
     grads  --psum_scatter-->  grad shard           (the reduce-scatter)
     shard update: fused Adam/LAMB Pallas kernel on the rank's shard of
         fp32 masters + moments
-    new masters --all_gather--> full fp32 buffers --> updates pytree
+    new masters --cast to wire dtype--> all_gather --> updates pytree
+
+The post-step all-gather moves WIRE-dtype params, not fp32 masters
+(``allgather_dtype``): "bf16" (default — half the fp32 wire bytes, the
+TPU-native analogue of the reference's fp16 gather), "e5m2" (fp8, a
+quarter; the reference's `e5m2_allgather=True` compressed mode —
+distributed_fused_adam.py:64,97,198-206 switches its gather buffer to
+uint8 e5m2 exactly this way), or "fp32" (exact master parity). The
+masters themselves always stay fp32 — only the gathered copy rounds,
+so precision loss does not compound across steps: after every step the
+model params equal wire_dtype(master), the reference's
+params-from-master contract.
 
 Knob collapse relative to the reference (SURVEY.md §7): the
 blocks/chunks/process-group plumbing (`dwu_num_blocks=4,
@@ -31,10 +42,14 @@ Both transformations must run where the data axis is bound (inside
 its FULL (unreduced) local grads — the reduce-scatter here replaces the
 DDP allreduce; do not pre-average.
 
-The returned updates are exact master-driven deltas: applying them with
-`optax.apply_updates` makes the model params bitwise equal to the cast
-of the fp32 masters — the semantics of the reference's post-step
-all-gather of fp16 params from fp32 shards.
+The returned updates are master-driven deltas: applying them with
+`optax.apply_updates` makes the model params equal the WIRE-dtype cast
+of the fp32 masters (to one fp32 ulp — the delta application re-rounds
+once), the semantics of the reference's post-step all-gather of fp16
+params from fp32 shards. With ``allgather_dtype="fp32"`` the params
+are bitwise equal to the cast of the masters. NOTE the round-5
+behavior change: the default wire is now "bf16" — callers that relied
+on the old exact-fp32 gather must pass ``allgather_dtype="fp32"``.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -127,12 +142,38 @@ def _scatter_grads(pg, dims, axis_name, world, predivide):
     return shards
 
 
-def _emit_updates(spec, pp, new_masters, dims, axis_name):
-    """all-gather new master shards; updates make p + u == cast(master)."""
+_WIRE_DTYPES = {
+    "fp32": None,
+    "bf16": jnp.bfloat16,
+    "e5m2": jnp.float8_e5m2,
+}
+
+
+def _wire_dtype(allgather_dtype):
+    try:
+        return _WIRE_DTYPES[allgather_dtype]
+    except KeyError:
+        raise ValueError(
+            f"allgather_dtype must be one of {sorted(_WIRE_DTYPES)}, "
+            f"got {allgather_dtype!r}"
+        ) from None
+
+
+def _emit_updates(spec, pp, new_masters, dims, axis_name, wire=None):
+    """all-gather new master shards in the wire dtype; updates make
+    p + u == wire_dtype(master) (== cast(master) for fp32 wire)."""
     deltas = []
     for pbuf, master, (rows_pad, _) in zip(pp.buffers, new_masters, dims):
-        full = jax.lax.all_gather(master, axis_name, axis=0, tiled=True)
-        full = full[: pbuf.shape[0]]
+        if wire is None:
+            send = master
+        else:
+            # saturate to the wire dtype's finite range: a plain
+            # astype overflows |m| > max_finite to inf (e5m2 tops out
+            # at 57344), which would poison the param permanently
+            fin = float(jnp.finfo(wire).max)
+            send = jnp.clip(master, -fin, fin).astype(wire)
+        full = jax.lax.all_gather(send, axis_name, axis=0, tiled=True)
+        full = full[: pbuf.shape[0]].astype(jnp.float32)
         deltas.append(full - pbuf.astype(jnp.float32))
     return c.deltas_to_updates(spec, deltas)
 
@@ -169,6 +210,7 @@ def distributed_fused_adam(
     grad_scale: Optional[Any] = None,
     max_grad_norm: float = 0.0,
     predivide: bool = True,
+    allgather_dtype: str = "bf16",
     axis_name: str = parallel_state.DATA_AXIS,
 ) -> optax.GradientTransformation:
     """ZeRO-sharded fused Adam over `axis_name`.
@@ -179,6 +221,7 @@ def distributed_fused_adam(
     (`clip_grad_norm=True` there). Must run with `axis_name` bound.
     """
     beta1, beta2 = betas
+    wire = _wire_dtype(allgather_dtype)
 
     def init_fn(params):
         spec = c.build_pack_spec(params)
@@ -232,7 +275,7 @@ def distributed_fused_adam(
             new_m.append(m2)
             new_v.append(v2)
 
-        updates = _emit_updates(spec, pp, new_master, dims, axis_name)
+        updates = _emit_updates(spec, pp, new_master, dims, axis_name, wire)
         return updates, DistributedAdamState(
             count=count,
             master=tuple(new_master),
@@ -257,6 +300,7 @@ def distributed_fused_lamb(
     weight_decay_mask: Optional[Any] = None,
     grad_scale: Optional[Any] = None,
     predivide: bool = True,
+    allgather_dtype: str = "bf16",
     axis_name: str = parallel_state.DATA_AXIS,
 ) -> optax.GradientTransformation:
     """ZeRO-sharded fused LAMB over `axis_name`.
@@ -269,6 +313,7 @@ def distributed_fused_lamb(
     """
     beta1, beta2 = betas
     beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    wire = _wire_dtype(allgather_dtype)
 
     def init_fn(params):
         spec = c.build_pack_spec(params)
@@ -357,7 +402,7 @@ def distributed_fused_lamb(
             new_m.append(m2)
             new_v.append(v2)
 
-        updates = _emit_updates(spec, pp, new_master, dims, axis_name)
+        updates = _emit_updates(spec, pp, new_master, dims, axis_name, wire)
         return updates, DistributedLAMBState(
             count=count,
             master=tuple(new_master),
@@ -383,6 +428,7 @@ class DistributedFusedAdam(c.FusedOptimizer):
         adam_w_mode: bool = True,
         max_grad_norm: float = 0.0,
         predivide: bool = True,
+        allgather_dtype: str = "bf16",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
     ):
@@ -401,6 +447,7 @@ class DistributedFusedAdam(c.FusedOptimizer):
                 weight_decay_mask=weight_decay_mask,
                 max_grad_norm=max_grad_norm,
                 predivide=predivide,
+                allgather_dtype=allgather_dtype,
                 axis_name=axis_name,
             )
         )
@@ -422,6 +469,7 @@ class DistributedFusedLAMB(c.FusedOptimizer):
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         predivide: bool = True,
+        allgather_dtype: str = "bf16",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
     ):
@@ -441,6 +489,7 @@ class DistributedFusedLAMB(c.FusedOptimizer):
                 max_grad_norm=max_grad_norm,
                 use_nvlamb=use_nvlamb,
                 predivide=predivide,
+                allgather_dtype=allgather_dtype,
                 weight_decay_mask=weight_decay_mask,
                 axis_name=axis_name,
             )

@@ -107,7 +107,8 @@ class TestDistributedFusedAdam:
         mean = jax.tree_util.tree_map(lambda g: g.mean(0), stacked)
 
         dist = distributed_fused_adam(
-            1e-2, weight_decay=0.01, predivide=predivide, axis_name="data"
+            1e-2, weight_decay=0.01, predivide=predivide,
+            allgather_dtype="fp32", axis_name="data"
         )
         ref = fused_adam(1e-2, weight_decay=0.01)
         got = run_sharded(dist, params, stacked, mesh)
@@ -144,7 +145,8 @@ class TestDistributedFusedAdam:
         mean = jax.tree_util.tree_map(lambda g: g.mean(0), stacked)
 
         dist = distributed_fused_adam(
-            1e-2, max_grad_norm=1.0, axis_name="data"
+            1e-2, max_grad_norm=1.0, allgather_dtype="fp32",
+            axis_name="data"
         )
         # unsharded reference: clip the mean grads by global norm first
         gsq = sum(
@@ -170,7 +172,8 @@ class TestDistributedFusedLAMB:
         mean = jax.tree_util.tree_map(lambda g: g.mean(0), stacked)
 
         dist = distributed_fused_lamb(
-            1e-2, weight_decay=0.01, use_nvlamb=use_nvlamb, axis_name="data"
+            1e-2, weight_decay=0.01, use_nvlamb=use_nvlamb,
+            allgather_dtype="fp32", axis_name="data"
         )
         ref = fused_lamb(1e-2, weight_decay=0.01, use_nvlamb=use_nvlamb)
         got = run_sharded(dist, params, stacked, mesh)
@@ -185,9 +188,113 @@ class TestDistributedFusedLAMB:
         mean = jax.tree_util.tree_map(lambda g: g.mean(0), stacked)
 
         dist = distributed_fused_lamb(
-            1e-2, weight_decay=0.1, weight_decay_mask=mask, axis_name="data"
+            1e-2, weight_decay=0.1, weight_decay_mask=mask,
+            allgather_dtype="fp32", axis_name="data"
         )
         ref = fused_lamb(1e-2, weight_decay=0.1, weight_decay_mask=mask)
         got = run_sharded(dist, params, stacked, mesh)
         want = run_reference(ref, params, mean)
         assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestAllgatherDtype:
+    """The low-precision post-step all-gather (reference
+    e5m2_allgather, distributed_fused_adam.py:64,97,198-206): wire
+    bytes halve (bf16) or quarter (e5m2) and the gathered params are
+    the wire-rounded masters. Tolerances pin the wire dtype's rounding
+    bound: the fp32-wire result is the exact master, so
+    |p_wire − p_fp32| ≤ ulp(wire) · |master| — 2^-8 relative for bf16
+    (8-bit mantissa step), 2^-2 for e5m2 (2-bit mantissa)."""
+
+    _cache: dict = {}
+
+    def _run(self, wire):
+        # identical inputs across tests: cache per wire dtype (3 jit
+        # compiles + sharded runs otherwise repeat)
+        if wire not in self._cache:
+            mesh = data_mesh()
+            params = make_params(jax.random.PRNGKey(10))
+            stacked = per_rank_grads(jax.random.PRNGKey(11), params)
+            dist = distributed_fused_adam(
+                1e-2, weight_decay=0.01, allgather_dtype=wire,
+                axis_name="data",
+            )
+            self._cache[wire] = run_sharded(dist, params, stacked, mesh)
+        return self._cache[wire]
+
+    def test_bf16_wire_within_rounding_of_fp32(self):
+        got = self._run("bf16")
+        want = self._run("fp32")
+        for x, y in zip(
+            jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=2 ** -8, atol=2e-6
+            )
+
+    def test_bf16_wire_is_bf16_of_master_to_one_ulp(self):
+        """Not merely close: the gathered value is bf16(master) up to
+        ONE fp32 ulp (updates apply as p + fl(bf16(m) − p), one fp32
+        re-round) — the same step with fp32 wire, rounded, must match
+        to that bound."""
+        got = self._run("bf16")
+        want = self._run("fp32")
+        for x, y in zip(
+            jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x),
+                np.asarray(
+                    jnp.asarray(y).astype(jnp.bfloat16).astype(jnp.float32)
+                ),
+                rtol=3e-7, atol=1e-9,
+            )
+
+    def test_e5m2_wire_within_rounding_of_fp32(self):
+        got = self._run("e5m2")
+        want = self._run("fp32")
+        for x, y in zip(
+            jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=2 ** -2, atol=1e-4
+            )
+
+    def test_unknown_dtype_rejected(self):
+        with pytest.raises(ValueError, match="allgather_dtype"):
+            distributed_fused_adam(1e-2, allgather_dtype="fp8")
+
+    def test_e5m2_wire_saturates_out_of_range_masters(self):
+        """Masters beyond e5m2's finite range (57344) must saturate on
+        the wire, not overflow to inf and poison the params."""
+        mesh = data_mesh()
+        params = {"w": jnp.full((8, 8), 1e6, jnp.float32)}
+        stacked = {"w": jnp.zeros((DP, 8, 8), jnp.float32)}
+        dist = distributed_fused_adam(
+            1e-2, allgather_dtype="e5m2", axis_name="data"
+        )
+        got = run_sharded(dist, params, stacked, mesh, steps=1)
+        arr = np.asarray(got["w"])
+        assert np.all(np.isfinite(arr))
+        fin = float(jnp.finfo(jnp.float8_e5m2).max)
+        np.testing.assert_allclose(arr, fin, rtol=1e-6)
+
+    def test_lamb_bf16_wire(self):
+        mesh = data_mesh()
+        params = make_params(jax.random.PRNGKey(12))
+        stacked = per_rank_grads(jax.random.PRNGKey(13), params)
+
+        def run(wire):
+            dist = distributed_fused_lamb(
+                1e-2, weight_decay=0.01, allgather_dtype=wire,
+                axis_name="data",
+            )
+            return run_sharded(dist, params, stacked, mesh)
+
+        for x, y in zip(
+            jax.tree_util.tree_leaves(run("bf16")),
+            jax.tree_util.tree_leaves(run("fp32")),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=2 ** -8, atol=2e-6
+            )
