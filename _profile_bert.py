@@ -1,0 +1,158 @@
+"""Dev driver: device-profile the BERT bench step (the BASELINE.md
+BERT per-op table — VERDICT round-4 item 2: BERT evidence at the GPT
+grade).
+
+Usage: python _profile_bert.py [iters] [--dropout=R] [--batch=N]
+[--remat] — runs bench.py bench_bert's exact step under
+jax.profiler.trace and aggregates with profiler.op_stats.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.models import BertConfig, BertModel
+from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
+from rocm_apex_tpu.utils.tree import path_str
+from rocm_apex_tpu import profiler
+
+_pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+ITERS = int(_pos[0]) if _pos else 20
+DROPOUT = 0.0
+BATCH = 0
+REMAT = "--remat" in sys.argv[1:]
+for _a in sys.argv[1:]:
+    if _a.startswith("--dropout="):
+        DROPOUT = float(_a.split("=", 1)[1])
+    elif _a.startswith("--batch="):
+        BATCH = int(_a.split("=", 1)[1])
+
+
+def main():
+    batch = BATCH or 8
+    seq = 512
+    cfg = BertConfig(
+        vocab_size=30592,
+        hidden_size=1024,
+        num_layers=24,
+        num_attention_heads=8,
+        ffn_hidden_size=4096,
+        max_position_embeddings=seq,
+        hidden_dropout=DROPOUT,
+        attention_dropout=DROPOUT,
+        tensor_parallel_size=1,
+        checkpoint_activations=REMAT,
+    )
+    model = BertModel(cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size
+    )
+    lm_labels = jnp.roll(tokens, 1, axis=1)
+    params = model.init(jax.random.PRNGKey(1), tokens[:1])
+    flat = jax.tree_util.tree_map_with_path(
+        lambda kp, _: not (
+            path_str(kp).endswith("bias") or "layernorm" in path_str(kp).lower()
+        ),
+        params,
+    )
+    opt = MixedPrecisionLamb(
+        1e-4, weight_decay=0.01, weight_decay_mask=flat,
+        compute_dtype=jnp.bfloat16, moment_dtype=jnp.bfloat16,
+        store_model=False,
+    )
+    state0 = opt.init(params)
+    if DROPOUT > 0.0 and jax.default_backend() == "tpu":
+        rng0 = jax.random.key(2, impl="rbg")
+    else:
+        rng0 = jax.random.PRNGKey(2)
+
+    def one_step(carry, _):
+        state, rng = carry
+        rng, step_rng = jax.random.split(rng)
+
+        def loss_fn(p):
+            losses, _ = model.apply(
+                p, tokens, lm_labels=lm_labels,
+                deterministic=DROPOUT == 0.0,
+                rngs={"dropout": step_rng} if DROPOUT > 0.0 else None,
+            )
+            return jnp.mean(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(opt.model_params(state))
+        state2, _ = opt.step_and_probe(state, grads)
+        return (state2, rng), loss
+
+    @jax.jit
+    def runN(state):
+        carry, losses = jax.lax.scan(
+            one_step, (state, rng0), None, length=ITERS
+        )
+        return carry, losses
+
+    carry, losses = runN(state0)
+    float(losses[-1])  # warmup
+
+    import tempfile
+    log_dir = tempfile.mkdtemp(prefix="bert_prof_")
+    with profiler.trace(log_dir):
+        carry, losses = runN(state0)
+        float(losses[-1])
+
+    stats = profiler.op_stats(log_dir, merge_numeric_suffix=False)
+    total = sum(s.total_ms for s in stats if s.name != "while")
+    print(f"device total (sans while): {total:.1f} ms over {ITERS} steps "
+          f"= {total / ITERS:.2f} ms/step")
+
+    hlo = runN.lower(state0).compile().as_text()
+    defs = {}
+    for line in hlo.splitlines():
+        t = line.strip()
+        if t.startswith("%") and "= " in t:
+            nm = t[1:].split(" ")[0]
+            defs.setdefault(nm, t[:240])
+
+    import re as _re
+
+    opnames = {}
+    for line in hlo.splitlines():
+        t = line.strip()
+        if t.startswith("%") and "op_name=" in t:
+            nm = t[1:].split(" ")[0]
+            m = _re.search(r'op_name="([^"]+)"', t)
+            if m:
+                opnames[nm] = m.group(1)
+
+    def sig(s):
+        d = defs.get(s.name, "")
+        m = _re.match(r"%\S+ = (\(?[a-z0-9]+\[[\d,]*\])", d)
+        shape = m.group(1) if m else "?"
+        op = opnames.get(s.name, "")
+        op = op.replace("jit(runN)/while/body/closed_call/", "")
+        bwd = "transpose(jvp" in op
+        op = _re.sub(r"transpose\(jvp\(BertModel\)\)/", "", op)
+        op = _re.sub(r"jvp\(BertModel\)/", "", op)
+        op = _re.sub(r"layer_\d+", "layer", op)
+        op = _re.sub(r"rematted_computation\[?", "", op)
+        kind = _re.sub(r"\.\d+$", "", s.name)
+        tag = "BWD " if bwd else ""
+        return f"{tag}{op or kind} -> {shape}"
+
+    groups = {}
+    for s in stats:
+        if s.name == "while":
+            continue
+        k = sig(s)
+        g = groups.setdefault(k, [0.0, 0, 0.0])
+        g[0] += s.total_ms
+        g[1] += s.count
+        g[2] = max(g[2], s.tflops_sec)
+    print(f"{'ms/step':>8} {'cnt/step':>8} {'tflops':>7}  signature")
+    for k, (ms, cnt, tf) in sorted(groups.items(), key=lambda kv: -kv[1][0]):
+        if ms / ITERS < 0.04:
+            continue
+        print(f"{ms / ITERS:8.3f} {cnt / ITERS:8.1f} {tf:7.1f}  {k[:120]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -192,17 +192,17 @@ def bench_rn50(fused: bool = False):
 
 def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
     """BASELINE.json config 4: BERT-Large-shaped MLM pretrain step with
-    FusedLAMB + fused LayerNorm, tokens/sec/chip. 24L/1024h with
-    head_dim 128 (the TPU-first head shape; see main()).
+    the mixed-precision LAMB recipe (bf16 model copy + fp32 masters,
+    `MixedPrecisionLamb` — norms fused into the update passes, no
+    materialized update buffer) + fused LayerNorm, tokens/sec/chip.
+    24L/1024h with head_dim 128 (the TPU-first head shape; see main()).
     ``--batch=16 --remat`` measures the large-batch config with
-    per-layer activation checkpointing (the b16 fit path)."""
+    per-layer activation checkpointing."""
     from rocm_apex_tpu.models import BertConfig, BertModel
-    from rocm_apex_tpu.optimizers import fused_lamb
+    from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
     from rocm_apex_tpu.utils.tree import path_str
 
     on_tpu = jax.default_backend() == "tpu"
-    # b8 fits without remat; b16 needs per-layer remat (330M params of
-    # fp32 LAMB p/m/v leave ~6 GB for activations on the 16 GB chip)
     batch = batch or (8 if on_tpu else 2)
     seq = 512 if on_tpu else 64
     iters = 20 if on_tpu else 2
@@ -223,18 +223,31 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
         jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size
     )
     lm_labels = jnp.roll(tokens, 1, axis=1)
-    params = model.init(jax.random.PRNGKey(1), tokens[:1])
+    params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
     flat = jax.tree_util.tree_map_with_path(
         lambda kp, _: not (
             path_str(kp).endswith("bias") or "layernorm" in path_str(kp).lower()
         ),
-        params,
+        params32,
     )
-    opt = fused_lamb(1e-4, weight_decay=0.01, weight_decay_mask=flat)
-    opt_state = opt.init(params)
+    # store_model=False: the bf16 model copy is cast from the masters
+    # in-scan instead of riding the carry — the carried copy would be
+    # double-buffered (2 x 0.66 GB), which is exactly the b8 OOM margin
+    # on the 16 GB chip
+    # bf16 moments: half the m/v traffic and state (the
+    # moment_dtype knob, tolerance pinned by
+    # test_mixed_precision.py::test_bf16_moments_close_to_fp32);
+    # with fp32 moments the b16 config exceeds the 16 GB chip
+    opt = MixedPrecisionLamb(
+        1e-4, weight_decay=0.01, weight_decay_mask=flat,
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        moment_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        store_model=False,
+    )
+    state = opt.init(params32)
 
     def one_step(carry, _):
-        params, opt_state, rng = carry
+        state, rng = carry
         rng, step_rng = jax.random.split(rng)
 
         def loss_fn(p):
@@ -245,25 +258,18 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
             )
             return jnp.mean(losses)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state2 = opt.update(grads, opt_state, params)
-        params2 = jax.tree_util.tree_map(
-            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
-            params,
-            updates,
-        )
-        return (params2, opt_state2, rng), loss
+        loss, grads = jax.value_and_grad(loss_fn)(opt.model_params(state))
+        state2, _ = opt.step_and_probe(state, grads)
+        return (state2, rng), loss
 
     @jax.jit
-    def runN(params, opt_state, rng):
+    def runN(state, rng):
         carry, losses = jax.lax.scan(
-            one_step, (params, opt_state, rng), None, length=iters
+            one_step, (state, rng), None, length=iters
         )
         return carry, losses
 
-    carry, losses = runN(
-        params, opt_state, _dropout_rng0(dropout, on_tpu)
-    )
+    carry, losses = runN(state, _dropout_rng0(dropout, on_tpu))
     float(losses[-1])
     t0 = time.perf_counter()
     carry, losses = runN(*carry)
@@ -271,7 +277,7 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
     dt = (time.perf_counter() - t0) / iters
     tok_s = batch * seq / dt
     n_params = sum(
-        int(x.size) for x in jax.tree_util.tree_leaves(params)
+        int(x.size) for x in jax.tree_util.tree_leaves(params32)
     ) - cfg.vocab_size * cfg.hidden_size
     # same Megatron-style crediting as the GPT bench: + the tied
     # MLM-head projection trio (see main())

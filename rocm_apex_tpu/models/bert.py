@@ -120,9 +120,15 @@ class BertModel(nn.Module):
         deterministic: bool = True,
     ):
         cfg = self.cfg
-        if attention_mask is None:
-            attention_mask = jnp.ones(tokens.shape, jnp.int32)
-        ext_mask = bert_extended_attention_mask(attention_mask)
+        # attention_mask=None means NO padded positions: keep it None
+        # so the attention layer takes the dense packed flash path
+        # (merged single-tile backward, no (b, s, s) zero-bias tensor)
+        # instead of masking against an all-keep tensor
+        ext_mask = (
+            bert_extended_attention_mask(attention_mask)
+            if attention_mask is not None
+            else None
+        )
 
         x = self.embedding(tokens, None, deterministic)
         if tokentype_ids is not None:

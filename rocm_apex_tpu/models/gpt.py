@@ -253,9 +253,19 @@ class ParallelAttention(nn.Module):
         use_flash = cfg.attention_impl == "flash" and (
             not dropout_active or use_flash_dropout
         )
+        # packed path: causal, or FULL bidirectional ("padding" type
+        # with no mask tensor — BERT with no padded positions): the
+        # dense packed kernels + merged single-tile backward serve it
+        # with causal=False, and no (b, s, s) zero-bias materializes
         will_pack = (
             use_flash
-            and self.attn_mask_type == "causal"
+            and (
+                self.attn_mask_type == "causal"
+                or (
+                    self.attn_mask_type == "padding"
+                    and attention_mask is None
+                )
+            )
             and cfg.context_parallel_axis is None
             and hd % 128 == 0
         )
@@ -308,6 +318,7 @@ class ParallelAttention(nn.Module):
             return jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
 
         if will_pack:
+            pk_causal = self.attn_mask_type == "causal"
             if qkv_bias is None:
                 # use_bias=False projection: the unbiased packed ops
                 from rocm_apex_tpu.ops.flash_attention import (
@@ -318,10 +329,10 @@ class ParallelAttention(nn.Module):
                 if use_flash_dropout:
                     ctx = flash_attention_qkv_dropout(
                         qkv, _dropout_seed(), cfg.attention_dropout,
-                        True, scale,
+                        pk_causal, scale,
                     )
                 else:
-                    ctx = flash_attention_qkv(qkv, True, scale)
+                    ctx = flash_attention_qkv(qkv, pk_causal, scale)
             elif use_flash_dropout:
                 from rocm_apex_tpu.ops.flash_attention import (
                     flash_attention_qkv_bias_dropout,
@@ -329,14 +340,16 @@ class ParallelAttention(nn.Module):
 
                 ctx = flash_attention_qkv_bias_dropout(
                     qkv, qkv_bias, _dropout_seed(),
-                    cfg.attention_dropout, True, scale,
+                    cfg.attention_dropout, pk_causal, scale,
                 )
             else:
                 from rocm_apex_tpu.ops.flash_attention import (
                     flash_attention_qkv_bias,
                 )
 
-                ctx = flash_attention_qkv_bias(qkv, qkv_bias, True, scale)
+                ctx = flash_attention_qkv_bias(
+                    qkv, qkv_bias, pk_causal, scale
+                )
         elif use_flash:
             q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
             qf = q.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
@@ -365,14 +378,17 @@ class ParallelAttention(nn.Module):
                     ctxf = flash_attention(qf, kf, vf, None, True, scale)
             else:
                 if attention_mask is None:
-                    raise ValueError("padding attention needs attention_mask")
-                # broadcastable (b|1, 1, sq|1, sk) True = masked ->
-                # additive (b, sq, sk)
-                fb = jnp.where(
-                    jnp.broadcast_to(attention_mask, (b, 1, sq, sq)),
-                    -1e30,
-                    0.0,
-                ).astype(jnp.float32)[:, 0]
+                    # no padded positions: FULL bidirectional — the
+                    # dense kernels need no bias tensor
+                    fb = None
+                else:
+                    # broadcastable (b|1, 1, sq|1, sk) True = masked ->
+                    # additive (b, sq, sk)
+                    fb = jnp.where(
+                        jnp.broadcast_to(attention_mask, (b, 1, sq, sq)),
+                        -1e30,
+                        0.0,
+                    ).astype(jnp.float32)[:, 0]
                 # fb is a constant padding mask: no dbias kernel
                 if use_flash_dropout:
                     from rocm_apex_tpu.ops.flash_attention import (
@@ -408,10 +424,14 @@ class ParallelAttention(nn.Module):
                     probs = jax.nn.softmax(s, axis=-1)
             else:
                 if attention_mask is None:
-                    raise ValueError("padding attention needs attention_mask")
-                mask = jnp.broadcast_to(
-                    attention_mask, (b, 1, sq, scores.shape[-1])
-                )
+                    # no padded positions: full softmax, nothing masked
+                    mask = jnp.zeros(
+                        (b, 1, sq, scores.shape[-1]), bool
+                    )
+                else:
+                    mask = jnp.broadcast_to(
+                        attention_mask, (b, 1, sq, scores.shape[-1])
+                    )
                 if use_pallas_softmax:
                     probs = scaled_masked_softmax(scores, mask, scale)
                 else:

@@ -134,3 +134,214 @@ class TestStepAndProbe:
             ):
                 np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
             assert int(s1.count) == int(s2.count)
+
+
+class TestMixedPrecisionLamb:
+    """MixedPrecisionLamb (the BERT-Large recipe) vs fused_lamb math:
+    same trust-ratio/clip/decay semantics on the master-weight state
+    (reference: fused_lamb.py:4-215 + fused_mixed_precision_lamb.py)."""
+
+    def _setup(self, **kw):
+        from rocm_apex_tpu.optimizers import fused_lamb
+        from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
+
+        params = make_params(jax.random.PRNGKey(2))
+        grads = jax.tree_util.tree_map(
+            lambda x: 0.3 * jnp.sign(x) + 0.1 * x, params
+        )
+        mask = {"w": True, "b": False}
+        opt = MixedPrecisionLamb(
+            1e-2, weight_decay=0.01, weight_decay_mask=mask,
+            compute_dtype=jnp.float32, **kw,
+        )
+        ref = fused_lamb(1e-2, weight_decay=0.01, weight_decay_mask=mask)
+        return params, grads, opt, ref
+
+    def test_matches_fused_lamb_fp32(self):
+        params, grads, opt, ref = self._setup()
+        state = opt.init(params)
+        rstate = ref.init(params)
+        rparams = params
+        for _ in range(5):
+            state, found_inf = opt.step_and_probe(state, grads)
+            assert not bool(found_inf)
+            updates, rstate = ref.update(grads, rstate, rparams)
+            rparams = optax.apply_updates(rparams, updates)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state.model),
+            jax.tree_util.tree_leaves(rparams),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_grad_norm_clip_active(self):
+        """Large grads trip the global clip the same way fused_lamb's
+        does (clip factor = max_norm/||g||)."""
+        params, grads, opt, ref = self._setup()
+        big = jax.tree_util.tree_map(lambda g: g * 100.0, grads)
+        state = opt.init(params)
+        state, _ = opt.step_and_probe(state, big)
+        rstate = ref.init(params)
+        updates, _ = ref.update(big, rstate, params)
+        rparams = optax.apply_updates(params, updates)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state.model),
+            jax.tree_util.tree_leaves(rparams),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_overflow_freezes_everything(self):
+        params, grads, opt, _ = self._setup()
+        state = opt.init(params)
+        state, _ = opt.step_and_probe(state, grads)
+        bad = jax.tree_util.tree_map(jnp.copy, grads)
+        bad["w"] = bad["w"].at[0, 0].set(jnp.inf)
+        state2, found_inf = opt.step_and_probe(state, bad)
+        assert bool(found_inf)
+        assert int(state2.count) == int(state.count)
+        for name in ("model", "master", "m", "v"):
+            for a, b in zip(
+                jax.tree_util.tree_leaves(getattr(state2, name)),
+                jax.tree_util.tree_leaves(getattr(state, name)),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_bf16_moments_close_to_fp32(self):
+        """moment_dtype=bf16 (half the m/v traffic/state) stays within
+        bf16 rounding of the fp32-moment trajectory over a few steps."""
+        from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
+
+        params, grads, opt32, _ = self._setup()
+        opt16 = MixedPrecisionLamb(
+            1e-2, weight_decay=0.01,
+            weight_decay_mask={"w": True, "b": False},
+            compute_dtype=jnp.float32, moment_dtype=jnp.bfloat16,
+        )
+        s32 = opt32.init(params)
+        s16 = opt16.init(params)
+        for _ in range(5):
+            s32, _ = opt32.step_and_probe(s32, grads)
+            s16, _ = opt16.step_and_probe(s16, grads)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s16.master),
+            jax.tree_util.tree_leaves(s32.master),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-2, atol=1e-4
+            )
+
+    def test_model_is_cast_of_master(self):
+        from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
+
+        params = make_params(jax.random.PRNGKey(3))
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        opt = MixedPrecisionLamb(1e-2)
+        state = opt.init(params)
+        state, _ = opt.step_and_probe(
+            state,
+            jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), grads),
+        )
+        for mo, ma in zip(
+            jax.tree_util.tree_leaves(state.model),
+            jax.tree_util.tree_leaves(state.master),
+        ):
+            assert mo.dtype == jnp.bfloat16
+            np.testing.assert_array_equal(
+                np.asarray(mo), np.asarray(ma.astype(jnp.bfloat16))
+            )
+
+    def test_pallas_leaf_kernel_path_matches_fused_lamb(self):
+        """Leaves >= 64K elements with lane-aligned cols route through
+        the per-leaf Pallas kernels (lamb_leaf_stage1/2) — same math as
+        the tree path / fused_lamb."""
+        from rocm_apex_tpu.optimizers import fused_lamb
+        from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
+
+        k1, k2 = jax.random.split(jax.random.PRNGKey(5))
+        params = {
+            # (600, 128): kernel path, rows NOT a block multiple (pad)
+            "big": jax.random.normal(k1, (600, 128)) * 0.1,
+            # small leaf: tree path
+            "b": jax.random.normal(k2, (24,)) * 0.01,
+        }
+        grads = jax.tree_util.tree_map(
+            lambda x: 0.3 * jnp.sign(x) + 0.1 * x, params
+        )
+        mask = {"big": True, "b": False}
+        opt = MixedPrecisionLamb(
+            1e-2, weight_decay=0.01, weight_decay_mask=mask,
+            compute_dtype=jnp.float32,
+        )
+        ref = fused_lamb(1e-2, weight_decay=0.01, weight_decay_mask=mask)
+        state = opt.init(params)
+        rstate = ref.init(params)
+        rparams = params
+        for _ in range(3):
+            state, found_inf = opt.step_and_probe(state, grads)
+            assert not bool(found_inf)
+            updates, rstate = ref.update(grads, rstate, rparams)
+            rparams = optax.apply_updates(rparams, updates)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state.master),
+            jax.tree_util.tree_leaves(rparams),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_pallas_path_overflow_freezes(self):
+        from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
+
+        params = {"big": jax.random.normal(jax.random.PRNGKey(6), (600, 128))}
+        grads = jax.tree_util.tree_map(lambda x: x * 0.1, params)
+        opt = MixedPrecisionLamb(1e-2, compute_dtype=jnp.float32)
+        state = opt.init(params)
+        state, _ = opt.step_and_probe(state, grads)
+        bad = {"big": grads["big"].at[0, 0].set(jnp.nan)}
+        state2, found_inf = opt.step_and_probe(state, bad)
+        assert bool(found_inf)
+        assert int(state2.count) == int(state.count)
+        for name in ("master", "m", "v"):
+            for a, b in zip(
+                jax.tree_util.tree_leaves(getattr(state2, name)),
+                jax.tree_util.tree_leaves(getattr(state, name)),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_store_model_false_matches(self):
+        """store_model=False keeps state.model None (no scan-carried
+        bf16 copy) and model_params() derives it from the masters —
+        trajectory identical to fused_lamb."""
+        from rocm_apex_tpu.optimizers import fused_lamb
+        from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
+
+        params = {
+            "big": 0.1 * jax.random.normal(jax.random.PRNGKey(7), (600, 128)),
+            "b": 0.01 * jax.random.normal(jax.random.PRNGKey(8), (24,)),
+        }
+        grads = jax.tree_util.tree_map(
+            lambda x: 0.3 * jnp.sign(x) + 0.1 * x, params
+        )
+        opt = MixedPrecisionLamb(
+            1e-2, weight_decay=0.01, compute_dtype=jnp.float32,
+            store_model=False,
+        )
+        ref = fused_lamb(1e-2, weight_decay=0.01)
+        state = opt.init(params)
+        rstate = ref.init(params)
+        rparams = params
+        for _ in range(3):
+            state, _ = opt.step_and_probe(state, grads)
+            updates, rstate = ref.update(grads, rstate, rparams)
+            rparams = optax.apply_updates(rparams, updates)
+        assert state.model is None
+        for a, b in zip(
+            jax.tree_util.tree_leaves(opt.model_params(state)),
+            jax.tree_util.tree_leaves(rparams),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
