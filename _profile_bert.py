@@ -1,20 +1,21 @@
-"""Dev driver: device-profile the BERT bench step (the BASELINE.md
-BERT per-op table — VERDICT round-4 item 2: BERT evidence at the GPT
-grade).
+"""Dev driver: device-profile the BERT bench step and print the
+per-fusion breakdown (the BASELINE.md BERT tables — VERDICT round-4
+item 2: BERT evidence at the GPT grade).
 
 Usage: python _profile_bert.py [iters] [--dropout=R] [--batch=N]
-[--remat] — runs bench.py bench_bert's exact step under
-jax.profiler.trace and aggregates with profiler.op_stats.
+[--remat] — runs the EXACT bench step (imported from
+bench.build_bert_train, so this profile cannot drift from the
+benchmark) under jax.profiler.trace and aggregates with
+profiler.op_stats.
 """
 
+import re as _re
 import sys
+import tempfile
 
 import jax
-import jax.numpy as jnp
 
-from rocm_apex_tpu.models import BertConfig, BertModel
-from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
-from rocm_apex_tpu.utils.tree import path_str
+from bench import build_bert_train
 from rocm_apex_tpu import profiler
 
 _pos = [a for a in sys.argv[1:] if not a.startswith("--")]
@@ -30,73 +31,15 @@ for _a in sys.argv[1:]:
 
 
 def main():
-    batch = BATCH or 8
-    seq = 512
-    cfg = BertConfig(
-        vocab_size=30592,
-        hidden_size=1024,
-        num_layers=24,
-        num_attention_heads=8,
-        ffn_hidden_size=4096,
-        max_position_embeddings=seq,
-        hidden_dropout=DROPOUT,
-        attention_dropout=DROPOUT,
-        tensor_parallel_size=1,
-        checkpoint_activations=REMAT,
+    runN, state0, rng0, cfg, batch, seq, _ = build_bert_train(
+        DROPOUT, BATCH, REMAT, ITERS
     )
-    model = BertModel(cfg)
-    tokens = jax.random.randint(
-        jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size
-    )
-    lm_labels = jnp.roll(tokens, 1, axis=1)
-    params = model.init(jax.random.PRNGKey(1), tokens[:1])
-    flat = jax.tree_util.tree_map_with_path(
-        lambda kp, _: not (
-            path_str(kp).endswith("bias") or "layernorm" in path_str(kp).lower()
-        ),
-        params,
-    )
-    opt = MixedPrecisionLamb(
-        1e-4, weight_decay=0.01, weight_decay_mask=flat,
-        compute_dtype=jnp.bfloat16, moment_dtype=jnp.bfloat16,
-        store_model=False,
-    )
-    state0 = opt.init(params)
-    if DROPOUT > 0.0 and jax.default_backend() == "tpu":
-        rng0 = jax.random.key(2, impl="rbg")
-    else:
-        rng0 = jax.random.PRNGKey(2)
-
-    def one_step(carry, _):
-        state, rng = carry
-        rng, step_rng = jax.random.split(rng)
-
-        def loss_fn(p):
-            losses, _ = model.apply(
-                p, tokens, lm_labels=lm_labels,
-                deterministic=DROPOUT == 0.0,
-                rngs={"dropout": step_rng} if DROPOUT > 0.0 else None,
-            )
-            return jnp.mean(losses)
-
-        loss, grads = jax.value_and_grad(loss_fn)(opt.model_params(state))
-        state2, _ = opt.step_and_probe(state, grads)
-        return (state2, rng), loss
-
-    @jax.jit
-    def runN(state):
-        carry, losses = jax.lax.scan(
-            one_step, (state, rng0), None, length=ITERS
-        )
-        return carry, losses
-
-    carry, losses = runN(state0)
+    carry, losses = runN(state0, rng0)
     float(losses[-1])  # warmup
 
-    import tempfile
     log_dir = tempfile.mkdtemp(prefix="bert_prof_")
     with profiler.trace(log_dir):
-        carry, losses = runN(state0)
+        carry, losses = runN(state0, rng0)
         float(losses[-1])
 
     stats = profiler.op_stats(log_dir, merge_numeric_suffix=False)
@@ -104,15 +47,13 @@ def main():
     print(f"device total (sans while): {total:.1f} ms over {ITERS} steps "
           f"= {total / ITERS:.2f} ms/step")
 
-    hlo = runN.lower(state0).compile().as_text()
+    hlo = runN.lower(state0, rng0).compile().as_text()
     defs = {}
     for line in hlo.splitlines():
         t = line.strip()
         if t.startswith("%") and "= " in t:
             nm = t[1:].split(" ")[0]
             defs.setdefault(nm, t[:240])
-
-    import re as _re
 
     opnames = {}
     for line in hlo.splitlines():

@@ -190,14 +190,12 @@ def bench_rn50(fused: bool = False):
     )
 
 
-def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
-    """BASELINE.json config 4: BERT-Large-shaped MLM pretrain step with
-    the mixed-precision LAMB recipe (bf16 model copy + fp32 masters,
-    `MixedPrecisionLamb` — norms fused into the update passes, no
-    materialized update buffer) + fused LayerNorm, tokens/sec/chip.
-    24L/1024h with head_dim 128 (the TPU-first head shape; see main()).
-    ``--batch=16 --remat`` measures the large-batch config with
-    per-layer activation checkpointing."""
+def build_bert_train(dropout: float = 0.0, batch: int = 0,
+                     remat: bool = False, iters: int = 0):
+    """The BERT bench step, importable: used by `bench_bert` AND
+    `_profile_bert.py`, so the committed profiles can never drift from
+    the benchmark they explain. Returns
+    ``(runN, state0, rng0, cfg, batch, seq, params32)``."""
     from rocm_apex_tpu.models import BertConfig, BertModel
     from rocm_apex_tpu.optimizers.mixed import MixedPrecisionLamb
     from rocm_apex_tpu.utils.tree import path_str
@@ -205,7 +203,7 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
     on_tpu = jax.default_backend() == "tpu"
     batch = batch or (8 if on_tpu else 2)
     seq = 512 if on_tpu else 64
-    iters = 20 if on_tpu else 2
+    iters = iters or (20 if on_tpu else 2)
     cfg = BertConfig(
         vocab_size=30592 if on_tpu else 1024,
         hidden_size=1024 if on_tpu else 64,
@@ -269,7 +267,26 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
         )
         return carry, losses
 
-    carry, losses = runN(state, _dropout_rng0(dropout, on_tpu))
+    return (
+        runN, state, _dropout_rng0(dropout, on_tpu), cfg, batch, seq,
+        params32,
+    )
+
+
+def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
+    """BASELINE.json config 4: BERT-Large-shaped MLM pretrain step with
+    the mixed-precision LAMB recipe (bf16 model copy + fp32 masters,
+    `MixedPrecisionLamb` — norms fused into the update passes, no
+    materialized update buffer) + fused LayerNorm, tokens/sec/chip.
+    24L/1024h with head_dim 128 (the TPU-first head shape; see main()).
+    ``--batch=16 --remat`` measures the large-batch config with
+    per-layer activation checkpointing."""
+    on_tpu = jax.default_backend() == "tpu"
+    iters = 20 if on_tpu else 2
+    runN, state, rng0, cfg, batch, seq, params32 = build_bert_train(
+        dropout, batch, remat, iters
+    )
+    carry, losses = runN(state, rng0)
     float(losses[-1])
     t0 = time.perf_counter()
     carry, losses = runN(*carry)

@@ -424,19 +424,18 @@ class ParallelAttention(nn.Module):
                     probs = jax.nn.softmax(s, axis=-1)
             else:
                 if attention_mask is None:
-                    # no padded positions: full softmax, nothing masked
-                    mask = jnp.zeros(
-                        (b, 1, sq, scores.shape[-1]), bool
-                    )
+                    # no padded positions: plain softmax — no all-False
+                    # mask tensor to materialize
+                    probs = jax.nn.softmax(scores * scale, axis=-1)
                 else:
                     mask = jnp.broadcast_to(
                         attention_mask, (b, 1, sq, scores.shape[-1])
                     )
-                if use_pallas_softmax:
-                    probs = scaled_masked_softmax(scores, mask, scale)
-                else:
-                    s = jnp.where(mask, -jnp.inf, scores * scale)
-                    probs = jax.nn.softmax(s, axis=-1)
+                    if use_pallas_softmax:
+                        probs = scaled_masked_softmax(scores, mask, scale)
+                    else:
+                        s = jnp.where(mask, -jnp.inf, scores * scale)
+                        probs = jax.nn.softmax(s, axis=-1)
             probs = probs.astype(cfg.dtype)
 
             if cfg.attention_dropout > 0.0:
