@@ -13,6 +13,7 @@ import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.parallel import SyncBatchNorm
@@ -21,6 +22,7 @@ __all__ = [
     "ResNet",
     "BasicBlock",
     "Bottleneck",
+    "FoldedConvBN",
     "resnet18",
     "resnet34",
     "resnet50",
@@ -42,11 +44,118 @@ def _norm(cfg_axis, dtype):
     )
 
 
+def _is_plain_bn(norm) -> bool:
+    """True when `norm` is the plain nn.BatchNorm partial (the fold's
+    moment identities would need cross-replica psums under SyncBN)."""
+    return getattr(norm, "func", None) is nn.BatchNorm
+
+
+class FoldedConvBN(nn.Module):
+    """1×1 conv + BatchNorm on a no-ReLU edge in ONE pass over the
+    input — the projection-shortcut (downsample) fold.
+
+    Training-mode BN statistics of a 1×1 conv's output are EXACT
+    functions of the input's first and second moments:
+
+        z = xs · W          (xs = the strided input view, (T, Cin))
+        mean_z = mean_x · W
+        var_z  = diag(Wᵀ G W) / T − mean_z²,   G = xsᵀ xs
+
+    so folding γ·rsqrt(var+ε) into W (and the matching shift into a
+    bias) yields the NORMALIZED output from a single matmul over xs —
+    the conv output is never written out for the stats read or the
+    normalize read. G costs one small (Cin, Cin) MXU matmul over data
+    the conv reads anyway. Measured 3.9× on the isolated stage-2
+    downsample chain (0.689 → 0.175 ms, BASELINE.md round-5 RN50
+    section); this is the graph-level version of the write-once
+    bottleneck structure the round-4 Pallas tap kernels could not win
+    at the conv itself. Eval mode is the classic inference BN fold of
+    the running statistics. Running stats update exactly as
+    `nn.BatchNorm(momentum, epsilon)` (fp32, fast-variance
+    convention)."""
+
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cin = x.shape[-1]
+        kernel = self.param(
+            "conv_kernel",
+            nn.initializers.lecun_normal(),
+            (1, 1, cin, self.features),
+            jnp.float32,
+        )
+        scale = self.param(
+            "bn_scale", nn.initializers.ones_init(), (self.features,),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bn_bias", nn.initializers.zeros_init(), (self.features,),
+            jnp.float32,
+        )
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda s: jnp.zeros(s, jnp.float32), (self.features,),
+        )
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda s: jnp.ones(s, jnp.float32), (self.features,),
+        )
+
+        s = self.strides
+        xs = x[:, ::s, ::s, :] if s > 1 else x
+        w = kernel.reshape(cin, self.features).astype(jnp.float32)
+
+        if not train:
+            mean = ra_mean.value
+            var = ra_var.value
+        else:
+            n, h, ww, _ = xs.shape
+            t = n * h * ww
+            x2 = xs.reshape(t, cin)
+            mean_x = jnp.mean(x2.astype(jnp.float32), axis=0)
+            gram = jnp.einsum(
+                "tc,td->cd", x2, x2, preferred_element_type=jnp.float32
+            )
+            mean = mean_x @ w
+            # fast-variance convention (flax _compute_stats):
+            # E[z²] − E[z]², clipped at zero against roundoff
+            var = jnp.maximum(
+                jnp.einsum("cd,ce,ed->d", w, gram, w) / t - mean * mean,
+                0.0,
+            )
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value
+                    + (1.0 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value
+                    + (1.0 - self.momentum) * var
+                )
+
+        rs = jax.lax.rsqrt(var + self.epsilon)
+        w_fold = (w * (scale * rs)[None, :]).astype(self.dtype)
+        b_fold = bias - scale * rs * mean
+        y = jnp.einsum(
+            "nhwc,cd->nhwd",
+            xs.astype(self.dtype),
+            w_fold,
+            preferred_element_type=jnp.float32,
+        ) + b_fold
+        return y.astype(self.dtype)
+
+
 class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     norm: Any = None
     dtype: jnp.dtype = jnp.float32
+    fold_downsample: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -63,13 +172,28 @@ class BasicBlock(nn.Module):
         )(y)
         y = self.norm(name="bn2")(y, use_running_average=not train)
         if residual.shape != y.shape:
-            residual = nn.Conv(
-                self.filters, (1, 1), (self.strides, self.strides),
-                use_bias=False, dtype=self.dtype, name="downsample_conv",
-            )(residual)
-            residual = self.norm(name="downsample_bn")(
-                residual, use_running_average=not train
-            )
+            if self.fold_downsample and _is_plain_bn(self.norm):
+                # no-ReLU edge: conv + BN in one pass over the input.
+                # OPT-IN: wins forward-only inference (3.9x isolated);
+                # the TRAIN step loses ~3 ms net to the fold backward
+                # (xs read twice more + strided-slice materialization)
+                # — BASELINE.md round-5 RN50 section has the numbers
+                kw = getattr(self.norm, "keywords", {})
+                residual = FoldedConvBN(
+                    self.filters, self.strides, dtype=self.dtype,
+                    momentum=kw.get("momentum", 0.9),
+                    epsilon=kw.get("epsilon", 1e-5),
+                    name="downsample_fold",
+                )(residual, train)
+            else:
+                residual = nn.Conv(
+                    self.filters, (1, 1), (self.strides, self.strides),
+                    use_bias=False, dtype=self.dtype,
+                    name="downsample_conv",
+                )(residual)
+                residual = self.norm(name="downsample_bn")(
+                    residual, use_running_average=not train
+                )
         return nn.relu(y + residual)
 
 
@@ -79,6 +203,7 @@ class Bottleneck(nn.Module):
     norm: Any = None
     dtype: jnp.dtype = jnp.float32
     expansion: int = 4
+    fold_downsample: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -101,14 +226,26 @@ class Bottleneck(nn.Module):
         )(y)
         y = self.norm(name="bn3")(y, use_running_average=not train)
         if residual.shape != y.shape:
-            residual = nn.Conv(
-                self.filters * self.expansion, (1, 1),
-                (self.strides, self.strides), use_bias=False,
-                dtype=self.dtype, name="downsample_conv",
-            )(residual)
-            residual = self.norm(name="downsample_bn")(
-                residual, use_running_average=not train
-            )
+            if self.fold_downsample and _is_plain_bn(self.norm):
+                # no-ReLU edge: conv + BN in one pass over the input
+                # (opt-in; see BasicBlock note and BASELINE.md)
+                kw = getattr(self.norm, "keywords", {})
+                residual = FoldedConvBN(
+                    self.filters * self.expansion, self.strides,
+                    dtype=self.dtype,
+                    momentum=kw.get("momentum", 0.9),
+                    epsilon=kw.get("epsilon", 1e-5),
+                    name="downsample_fold",
+                )(residual, train)
+            else:
+                residual = nn.Conv(
+                    self.filters * self.expansion, (1, 1),
+                    (self.strides, self.strides), use_bias=False,
+                    dtype=self.dtype, name="downsample_conv",
+                )(residual)
+                residual = self.norm(name="downsample_bn")(
+                    residual, use_running_average=not train
+                )
         return nn.relu(y + residual)
 
 
@@ -131,6 +268,10 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     sync_bn_axis: Optional[str] = None
     fused: bool = False
+    # opt-in projection-shortcut fold (FoldedConvBN): a win for
+    # forward-only inference, a net loss for the train step —
+    # BASELINE.md round-5 RN50 section has the measurements
+    fold_downsample: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -169,6 +310,7 @@ class ResNet(nn.Module):
                     strides=strides,
                     norm=norm,
                     dtype=self.dtype,
+                    fold_downsample=self.fold_downsample,
                     name=f"layer{i + 1}_{j}",
                 )(x, train)
         x = jnp.mean(x, axis=(1, 2))
