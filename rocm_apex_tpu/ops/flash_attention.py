@@ -1484,6 +1484,12 @@ def flash_attention_with_lse(
     cotangents folded into the fused backward; like `flash_attention`,
     bias gradients are an explicit ``compute_dbias=True`` opt-in (the
     ring masks are constants).
+
+    BEHAVIOR CHANGE (round 4): ``compute_dbias`` previously defaulted
+    to True here. A caller differentiating a LEARNED bias must now
+    pass ``compute_dbias=True`` or the bias cotangent is exact zero —
+    silently, since the structure is unchanged. All in-repo callers
+    pass constant masks (bias=None or padding masks).
     """
     return _fwd(
         q, k, v, bias, causal,

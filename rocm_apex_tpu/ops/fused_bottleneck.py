@@ -230,6 +230,15 @@ def _win_specs(bp: int, lo: int, ptot: int, c: int):
     Edge chunks clamp the sliver index into range and read real-but-
     wrong rows — every tap that could touch them is masked with
     `where`, so the values never matter."""
+    if bp % lo != 0 and bp != ptot:
+        # the sliver index maps below assume bp is a multiple of lo
+        # whenever grid > 1 (guaranteed by _pix_block's lo-stepped
+        # search); a silent k=0 here would make BOTH slivers index
+        # block 0 for every chunk — wrong windows, no error
+        raise ValueError(
+            f"_win_specs: chunk {bp} is neither a multiple of the halo "
+            f"row-group {lo} nor the whole stream {ptot}"
+        )
     k = bp // lo if bp % lo == 0 else 0
     last = max(0, -(-ptot // lo) - 1)
 

@@ -203,3 +203,77 @@ class TestModule:
         logits, _ = model.apply(vs, x, mutable=["batch_stats"])
         assert logits.shape == (1, 10)
         assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestMultiChunkGrid:
+    """Shrunk VMEM targets force grid > 1 through the 3x3 kernels'
+    halo-sliver window assembly (_win_specs clamping, _tap_bits seam
+    masking, sliver accumulation) — at default targets the test shapes
+    always run grid-of-1 and that machinery is never exercised
+    (round-4 advisor finding). With these targets and (2, 12, 10)
+    pixels: _pix_block(240, lo=16, target=3072//(16*4)=48) -> bp=48,
+    grid=5 forward; the backward and 1x1 paths shrink similarly."""
+
+    @pytest.fixture()
+    def small_targets(self, monkeypatch):
+        import rocm_apex_tpu.ops.fused_bottleneck as fb
+
+        monkeypatch.setitem(fb.config, "c3_fwd_target", 3 * 1024)
+        monkeypatch.setitem(fb.config, "c3_bwd_target", 2 * 1024)
+        monkeypatch.setitem(fb.config, "mm_target", 3 * 1024)
+        # sanity: the targets actually produce a multi-chunk grid
+        assert fb._pix_block(240, 16, 8, 16, fb.config["c3_fwd_target"]) < 240
+        return fb
+
+    def test_forward_grid_gt_1_exact(self, small_targets):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 12, 10, 8))
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 8, 16)) * 0.3
+        y, (s1, s2) = conv3x3_bn_act(x, w, stats=True)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        assert_close(np.asarray(y), np.asarray(ref), rtol=1e-4, atol=1e-4)
+        assert_close(
+            np.asarray(s1), np.asarray(ref.sum((0, 1, 2))),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_block_gradients_grid_gt_1(self, small_targets):
+        p = _params(jax.random.PRNGKey(3), 16, 4, 16, False)
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 12, 10, 16))
+        ct = jax.random.normal(jax.random.PRNGKey(4), (2, 12, 10, 16))
+        argnums = tuple(range(len(p) + 1))
+        gf = jax.grad(
+            lambda x, *p: jnp.sum(
+                bottleneck_fused(EPS, False, x, *p)[0] * ct
+            ),
+            argnums=argnums,
+        )(x, *p)
+        gr = jax.grad(
+            lambda x, *p: jnp.sum(ref_block(x, *p) * ct),
+            argnums=argnums,
+        )(x, *p)
+        for a, b in zip(gf, gr):
+            scale = float(jnp.max(jnp.abs(b))) + 1e-8
+            err = float(jnp.max(jnp.abs(a - b)))
+            assert err / scale < 2e-3, (err, scale)
+
+
+def test_bn_variance_offset_distribution():
+    """Round-4 advisor finding: the kernels accumulate E[y²]−E[y]²
+    single-pass in f32; channels with |mean| >> std can lose variance
+    precision. Pin the achieved accuracy at an offset distribution
+    (mean ~10, std 0.1 — variance is 1e-2 against sumsq terms ~1e2 per
+    row, a 1e4 cancellation) on a realistically deep pixel stream."""
+    m = 8192
+    x = jax.random.normal(jax.random.PRNGKey(0), (m, 8)) * 0.1 + 10.0
+    w = jnp.eye(8)
+    _, (s1, s2) = conv1x1_bn_act(x, w, stats=True)
+    mean = np.asarray(s1) / m
+    var_fast = np.asarray(s2) / m - mean**2
+    xf = np.asarray(x, np.float64)
+    var_ref = xf.var(axis=0)
+    # two-pass f64 reference vs the kernels' single-pass f32: the
+    # committed bound documents the tradeoff the kernels make
+    np.testing.assert_allclose(var_fast, var_ref, rtol=2e-2, atol=1e-4)
