@@ -41,7 +41,8 @@ from rocm_apex_tpu.parallel import sync_gradients
 def parse_args():
     p = argparse.ArgumentParser(description="rocm_apex_tpu imagenet example")
     p.add_argument("--arch", default="resnet50",
-                   choices=["resnet18", "resnet34", "resnet50", "resnet101"])
+                   choices=["resnet_tiny", "resnet18", "resnet34",
+                            "resnet50", "resnet101"])
     p.add_argument("--opt-level", default="O5",
                    choices=["O0", "O1", "O2", "O3", "O4", "O5"])
     p.add_argument("--loss-scale", default=None,

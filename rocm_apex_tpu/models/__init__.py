@@ -24,7 +24,9 @@ from rocm_apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401
 from rocm_apex_tpu.models.resnet import (  # noqa: F401
     BasicBlock,
     Bottleneck,
+    FoldedConvBN,
     ResNet,
+    resnet_tiny,
     resnet18,
     resnet34,
     resnet50,

@@ -23,6 +23,7 @@ __all__ = [
     "BasicBlock",
     "Bottleneck",
     "FoldedConvBN",
+    "resnet_tiny",
     "resnet18",
     "resnet34",
     "resnet50",
@@ -318,6 +319,14 @@ class ResNet(nn.Module):
         return x
 
 
+# test/smoke vehicle: the smallest ResNet that still exercises BN,
+# blocks, and the projection shortcut through the SAME code paths —
+# the L1 determinism cross-product and example smokes use it so their
+# per-config compiles cost seconds, not minutes (the literal RN50
+# north-star config keeps its own full-scale L1 test)
+resnet_tiny = functools.partial(
+    ResNet, stage_sizes=(1, 1), block=BasicBlock, num_filters=8
+)
 resnet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
 resnet34 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
 resnet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block=Bottleneck)

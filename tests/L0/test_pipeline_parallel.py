@@ -510,14 +510,15 @@ class TestPipelineWithEmbedding:
         )
         losses, (lgrads, egrads) = jax.jit(f)(stacked, e_params, tokens, labels)
 
-        # serial reference
+        # serial reference — scan over the stacked layer params (the
+        # layers are uniform): tracing one layer body instead of PP
+        # unrolled copies roughly halves this test's compile time
         def total_loss(lp, ep):
             def one(tok, tgt):
                 x = emb.apply(ep, tok)
-                for s in range(PP):
-                    x = layer.apply(
-                        jax.tree_util.tree_map(lambda v: v[s], lp), x
-                    )
+                x = jax.lax.scan(
+                    lambda h, p: (layer.apply(p, h), None), x, lp
+                )[0]
                 logits = emb.apply(ep, x, method=TransformerEmbedding.attend)
                 return jnp.mean(_serial_cross_entropy(logits, tgt))
 
@@ -621,13 +622,15 @@ class TestPipelineWithEmbedding:
         )
         losses, (lgrads, egrads) = jax.jit(f)(chunked, e_params, tokens, labels)
 
+        # serial reference — scan over the stacked layers (see the
+        # linear test's note; n_layers=8 unrolled copies dominated the
+        # compile here)
         def total_loss(lp, ep):
             def one(tok, tgt):
                 x = emb.apply(ep, tok)
-                for g in range(n_layers):
-                    x = layer.apply(
-                        jax.tree_util.tree_map(lambda v: v[g], lp), x
-                    )
+                x = jax.lax.scan(
+                    lambda h, p: (layer.apply(p, h), None), x, lp
+                )[0]
                 logits = emb.apply(ep, x, method=TransformerEmbedding.attend)
                 return jnp.mean(_serial_cross_entropy(logits, tgt))
 

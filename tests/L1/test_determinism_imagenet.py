@@ -10,9 +10,12 @@ example's own jitted shard_map step over the ``data`` mesh axis, mesh
 included, not a reimplementation — on the simulated 8-device mesh.
 
 Fidelity/runtime split: the north-star config (ResNet-50 + O5) runs
-the bitwise two-execution bar; the cross-product legs run ResNet-18
-through the SAME build_training (identical step code, smaller compile).
-The full {O0–O5} × loss-scale product at toy scale lives in
+the bitwise two-execution bar; the cross-product legs run the
+`resnet_tiny` vehicle through the SAME build_training (identical step
+code, mesh, and amp wiring; the model is smaller — BasicBlock at
+width 8, so the Bottleneck block itself is covered only by the
+north-star test. A ResNet-18 leg cost ~100 s of CPU compile PER
+CONFIG and the family alone blew the L1 budget). The full {O0–O5} × loss-scale product at toy scale lives in
 test_determinism_cross_product.py.
 
 Tolerance tiers:
@@ -92,7 +95,7 @@ def _trace_fn(arch, opt_level, loss_scale, keep_bn, seed=0, fresh=False):
 
 
 def run_training(opt_level, loss_scale=None, keep_bn=None,
-                 arch="resnet18", fresh=False):
+                 arch="resnet_tiny", fresh=False):
     """Loss trace of the example's step (the compare.py artifact).
     ``fresh=True`` rebuilds + recompiles from scratch (bypassing the
     module cache) — the reference's compare.py bar runs main_amp.py as
