@@ -350,3 +350,31 @@ class TestFoldedConvBN:
         np.testing.assert_allclose(
             np.asarray(ye_f), np.asarray(ye_c), rtol=2e-4, atol=2e-5
         )
+
+
+def test_resnet_fold_downsample_flag():
+    """fold_downsample=True routes every projection shortcut through
+    FoldedConvBN (params under downsample_fold/) and trains: the
+    opt-in integration path, not just the module in isolation."""
+    from rocm_apex_tpu.models import resnet_tiny
+
+    m = resnet_tiny(num_classes=4, fold_downsample=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16, 3))
+    v = m.init(jax.random.PRNGKey(1), x)
+    names = {
+        "/".join(getattr(k, "key", str(k)) for k in kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(v["params"])[0]
+    }
+    assert any("downsample_fold/conv_kernel" in n for n in names), names
+    assert not any("downsample_conv" in n for n in names)
+    y, mut = m.apply(v, x, mutable=["batch_stats"])
+    assert y.shape == (4, 4)
+    g = jax.grad(
+        lambda p: jnp.sum(
+            m.apply({**v, "params": p}, x, mutable=["batch_stats"])[0] ** 2
+        )
+    )(v["params"])
+    assert all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree_util.tree_leaves(g)
+    )
