@@ -1,0 +1,109 @@
+"""KV-cached GPT generation through the continuous-batching engine.
+
+The serving-side counterpart of `examples/gpt_train.py`: builds a GPT,
+leases cache slots to a queue of mixed-length requests, and drives the
+engine's admit → decode → evict loop, printing per-request outputs and
+aggregate decode throughput. With random init the tokens are noise —
+the point is the serving machinery: one compiled prefill, ONE compiled
+decode step reused across every tick (the trace counters printed at
+the end must both read 1), per-slot KV cache reuse.
+
+CPU smoke:
+    JAX_PLATFORMS=cpu python examples/generate_gpt.py \
+        --num-layers 2 --hidden-size 64 --num-attention-heads 4 \
+        --max-seq-len 64 --num-slots 2 --num-requests 6 \
+        --max-new-tokens 8
+"""
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from rocm_apex_tpu.inference import InferenceEngine, SamplingParams
+from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--hidden-size", type=int, default=64)
+    p.add_argument("--num-attention-heads", type=int, default=4)
+    p.add_argument("--vocab-size", type=int, default=512)
+    p.add_argument("--max-seq-len", type=int, default=64,
+                   help="cache capacity == max_position_embeddings")
+    p.add_argument("--max-prompt-len", type=int, default=16)
+    p.add_argument("--num-slots", type=int, default=2)
+    p.add_argument("--num-requests", type=int, default=6)
+    p.add_argument("--max-new-tokens", type=int, default=8)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    cfg = GPTConfig(
+        vocab_size=args.vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_attention_heads=args.num_attention_heads,
+        max_position_embeddings=args.max_seq_len,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        tensor_parallel_size=1,
+    )
+    model = GPTModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, args.max_prompt_len), jnp.int32),
+    )
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(params)
+    )
+    print(f"model: {n_params / 1e6:.1f}M params, "
+          f"{jax.default_backend()} backend")
+
+    eng = InferenceEngine(
+        model, params,
+        num_slots=args.num_slots,
+        max_prompt_len=args.max_prompt_len,
+        capacity=args.max_seq_len,
+        sampling=SamplingParams(
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+        ),
+        seed=args.seed,
+    )
+
+    rng = np.random.RandomState(args.seed)
+    prompts = [
+        rng.randint(0, args.vocab_size,
+                    size=rng.randint(1, args.max_prompt_len + 1)).tolist()
+        for _ in range(args.num_requests)
+    ]
+
+    t0 = time.perf_counter()
+    results = eng.generate(prompts, max_new_tokens=args.max_new_tokens)
+    dt = time.perf_counter() - t0
+
+    n_gen = sum(len(r.tokens) for r in results)
+    for r in results:
+        print(f"req {r.request_id}: prompt[{len(r.prompt)}] -> "
+              f"{r.tokens} ({r.finish_reason})")
+    print(f"generated {n_gen} tokens across {len(results)} requests "
+          f"in {dt:.2f}s ({n_gen / dt:.1f} tok/s) | "
+          f"prefill traces={eng.prefill_trace_count} "
+          f"decode traces={eng.decode_trace_count}")
+    if eng.decode_trace_count != 1 or eng.prefill_trace_count != 1:
+        raise SystemExit("decode/prefill retraced — serving loop broken")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,9 @@ Subpackage map (mirrors the reference's public surface, SURVEY.md §1):
                     group BN, ZeRO-style distributed optimizers
     ops             the Pallas kernel layer (shared by everything above)
     models          flax reference models (ResNet, DCGAN, GPT, BERT)
+    inference       serving tier (beyond the reference): KV cache,
+                    single-token decode kernel, sampling,
+                    continuous-batching engine
 """
 
 import logging as _logging

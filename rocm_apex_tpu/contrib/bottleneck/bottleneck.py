@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.parallel import SyncBatchNorm
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange"]
 
@@ -32,7 +33,7 @@ def halo_exchange(x: jnp.ndarray, axis_name: str, halo: int = 1) -> jnp.ndarray:
     The collective analogue of the reference's halo send/recv
     (reference bottleneck.py SpatialBottleneck halo streams).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     top = x[:, :halo]      # first rows -> previous rank's bottom halo
     bot = x[:, -halo:]     # last rows  -> next rank's top halo

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from rocm_apex_tpu.parallel import SyncBatchNorm
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["BatchNorm2d_NHWC"]
 
@@ -52,7 +53,7 @@ class BatchNorm2d_NHWC(nn.Module):
         axis = self.axis_name if self.bn_group > 1 else None
         if axis is not None:
             try:
-                world = jax.lax.axis_size(axis)
+                world = axis_size(axis)
             except NameError:
                 world = 1
                 axis = None

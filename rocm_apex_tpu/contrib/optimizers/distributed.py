@@ -18,14 +18,15 @@ axis inside `shard_map`, applied to the packed dtype-group buffers
     new masters --cast to wire dtype--> all_gather --> updates pytree
 
 The post-step all-gather moves WIRE-dtype params, not fp32 masters
-(``allgather_dtype``): "bf16" (default — half the fp32 wire bytes, the
-TPU-native analogue of the reference's fp16 gather), "e5m2" (fp8, a
-quarter; the reference's `e5m2_allgather=True` compressed mode —
-distributed_fused_adam.py:64,97,198-206 switches its gather buffer to
-uint8 e5m2 exactly this way), or "fp32" (exact master parity). The
-masters themselves always stay fp32 — only the gathered copy rounds,
-so precision loss does not compound across steps: after every step the
-model params equal wire_dtype(master), the reference's
+(``allgather_dtype``): "fp32" (default — bitwise master parity, the
+reference's default allgather semantics), "bf16" (half the fp32 wire
+bytes, the TPU-native analogue of the reference's fp16 gather), or
+"e5m2" (fp8, a quarter; the reference's `e5m2_allgather=True`
+compressed mode — distributed_fused_adam.py:64,97,198-206 switches its
+gather buffer to uint8 e5m2 exactly this way). The masters themselves
+always stay fp32 — with a low-precision wire only the gathered copy
+rounds, so precision loss does not compound across steps: after every
+step the model params equal wire_dtype(master), the reference's
 params-from-master contract.
 
 Knob collapse relative to the reference (SURVEY.md §7): the
@@ -46,10 +47,12 @@ The returned updates are master-driven deltas: applying them with
 `optax.apply_updates` makes the model params equal the WIRE-dtype cast
 of the fp32 masters (to one fp32 ulp — the delta application re-rounds
 once), the semantics of the reference's post-step all-gather of fp16
-params from fp32 shards. With ``allgather_dtype="fp32"`` the params
-are bitwise equal to the cast of the masters. NOTE the round-5
-behavior change: the default wire is now "bf16" — callers that relied
-on the old exact-fp32 gather must pass ``allgather_dtype="fp32"``.
+params from fp32 shards. Under the default ``allgather_dtype="fp32"``
+the params are bitwise equal to the masters (the reference's master
+parity, restored as the default after round 5's brief bf16 flip —
+silent 2⁻⁸-tier param rounding is not a defensible default); the
+low-precision wires are the explicit opt-in for gather-bandwidth-bound
+runs.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -65,6 +68,7 @@ from rocm_apex_tpu.ops.optim_kernels import BLOCK_ROWS
 from rocm_apex_tpu.ops.packing import group_segment_ids, respec
 from rocm_apex_tpu.optimizers import _common as c
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = [
     "distributed_fused_adam",
@@ -96,7 +100,7 @@ def _round_up(x: int, m: int) -> int:
 
 def _shard_meta(spec, axis_name):
     """(world, rank, [(rows_padded, shard_rows) per group])."""
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     dims = []
     for g in spec.groups:
@@ -210,7 +214,7 @@ def distributed_fused_adam(
     grad_scale: Optional[Any] = None,
     max_grad_norm: float = 0.0,
     predivide: bool = True,
-    allgather_dtype: str = "bf16",
+    allgather_dtype: str = "fp32",
     axis_name: str = parallel_state.DATA_AXIS,
 ) -> optax.GradientTransformation:
     """ZeRO-sharded fused Adam over `axis_name`.
@@ -300,7 +304,7 @@ def distributed_fused_lamb(
     weight_decay_mask: Optional[Any] = None,
     grad_scale: Optional[Any] = None,
     predivide: bool = True,
-    allgather_dtype: str = "bf16",
+    allgather_dtype: str = "fp32",
     axis_name: str = parallel_state.DATA_AXIS,
 ) -> optax.GradientTransformation:
     """ZeRO-sharded fused LAMB over `axis_name`.
@@ -428,7 +432,7 @@ class DistributedFusedAdam(c.FusedOptimizer):
         adam_w_mode: bool = True,
         max_grad_norm: float = 0.0,
         predivide: bool = True,
-        allgather_dtype: str = "bf16",
+        allgather_dtype: str = "fp32",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
     ):
@@ -469,7 +473,7 @@ class DistributedFusedLAMB(c.FusedOptimizer):
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         predivide: bool = True,
-        allgather_dtype: str = "bf16",
+        allgather_dtype: str = "fp32",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
     ):

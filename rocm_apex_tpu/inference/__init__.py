@@ -1,0 +1,44 @@
+"""Serving tier: KV-cached decoding + continuous batching.
+
+Opens the inference workload over the training stack — every training
+subsystem (amp dtypes, Pallas attention kernels, profiler) is reused,
+nothing is forked:
+
+    kv_cache   preallocated slot-paged KV cache pytree (bf16 default,
+               in-place dynamic_update_slice writes, per-slot lengths)
+    sampling   greedy / temperature / top-k / top-p, jit-able and
+               seed-deterministic
+    engine     continuous-batching serving loop: fixed slot grid,
+               request queue, per-step admit/evict, ONE compiled
+               decode_step with donated cache buffers
+
+The model side lives in `models/gpt.py` (``cache=`` on `GPTModel`) and
+`ops/flash_attention.py` (`flash_attention_decode`); this package owns
+the cache layout and the serving loop. See docs/inference.md.
+"""
+
+from rocm_apex_tpu.inference.engine import (  # noqa: F401
+    GenerationResult,
+    InferenceEngine,
+    Request,
+    SamplingParams,
+)
+from rocm_apex_tpu.inference.kv_cache import KVCache  # noqa: F401
+from rocm_apex_tpu.inference.sampling import (  # noqa: F401
+    greedy,
+    sample,
+    top_k_logits,
+    top_p_logits,
+)
+
+__all__ = [
+    "KVCache",
+    "InferenceEngine",
+    "Request",
+    "GenerationResult",
+    "SamplingParams",
+    "greedy",
+    "sample",
+    "top_k_logits",
+    "top_p_logits",
+]

@@ -1,0 +1,352 @@
+"""Continuous-batching generation engine over the KV-cached GPT.
+
+The serving loop the ROADMAP's "heavy traffic" story needs: a fixed
+grid of batch slots (the preallocated `KVCache`), a host-side request
+queue, and per-step admit/evict — a finished sequence frees its slot
+at the end of a step and a queued request claims it at the start of
+the next, so the compiled decode program never changes shape while the
+set of in-flight requests churns (the continuous-batching design of
+modern LLM servers, compiled-program-friendly).
+
+Two compiled programs serve everything:
+
+* ``prefill``: one request's padded prompt through the model against a
+  single-slot cache view, scattered back into the full cache, first
+  token sampled from the last REAL prompt position. Traced once (the
+  prompt pad width is fixed at construction).
+* ``decode_step``: ONE token for EVERY slot — active or not — in a
+  single jit program with the cache buffers donated, so the per-token
+  cost is one program dispatch and in-place cache writes, no per-token
+  Python dispatch into XLA and no cache copies. Traced once; the
+  engine exposes ``decode_trace_count`` so tests pin that invariant.
+
+Inactive slots ride along as dead rows (their sampled tokens are
+discarded and their lengths pinned) — uniform shapes beat ragged
+dispatch, the same padded-slot trade the training stack's pipeline
+microbatching makes.
+
+Determinism: one engine-owned PRNG key, split once per compiled call;
+a fixed seed replays the exact token stream for the same arrival
+order regardless of wall-clock timing.
+"""
+
+import collections
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocm_apex_tpu import profiler
+from rocm_apex_tpu.inference.kv_cache import KVCache
+from rocm_apex_tpu.inference.sampling import sample
+from rocm_apex_tpu.ops._pallas import on_tpu
+
+__all__ = [
+    "SamplingParams",
+    "Request",
+    "GenerationResult",
+    "InferenceEngine",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Static sampling config — fixed per engine (it is baked into the
+    compiled decode program). ``temperature=0`` is greedy."""
+
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    request_id: int
+    prompt: List[int]
+    tokens: List[int]  # generated ids (includes the eos when hit)
+    finish_reason: str  # "eos" | "length" | "capacity"
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side bookkeeping for one leased cache slot."""
+
+    req: Request
+    generated: List[int]
+    pos: int  # tokens materialized in the cache for this slot
+
+
+class InferenceEngine:
+    """Continuous-batching serving loop for a `GPTModel`.
+
+    ``model``/``params`` are the trained flax module and its variables
+    (the same pytree `GPTModel.init` returns — serving reuses the
+    training checkpoint directly). The cache dtype defaults to the
+    model's compute dtype (bf16 under the O4/O5 recipe).
+
+    Single-chip (tp=1) in this PR; the cache layout already stores
+    LOCAL head shards, so multi-chip sharded serving is a cache-
+    compatible follow-up.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        num_slots: int = 8,
+        max_prompt_len: Optional[int] = None,
+        capacity: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None,
+        seed: int = 0,
+        cache_dtype: Any = None,
+    ):
+        cfg = model.cfg
+        if (cfg.tensor_parallel_size or 1) > 1:
+            raise NotImplementedError(
+                "multi-chip serving (tp > 1) is a future PR; build the "
+                "engine with tensor_parallel_size=1"
+            )
+        self.model = model
+        self.params = params
+        self.capacity = int(capacity or cfg.max_position_embeddings)
+        if self.capacity > cfg.max_position_embeddings:
+            raise ValueError(
+                f"capacity {self.capacity} exceeds "
+                f"max_position_embeddings {cfg.max_position_embeddings}"
+            )
+        self.max_prompt_len = int(max_prompt_len or self.capacity)
+        if not 0 < self.max_prompt_len <= self.capacity:
+            raise ValueError(
+                f"max_prompt_len {self.max_prompt_len} must be in "
+                f"(0, capacity={self.capacity}]"
+            )
+        self.eos_id = eos_id
+        self.sampling = sampling or SamplingParams()
+        self.cache = KVCache.for_model(
+            cfg, num_slots, self.capacity, dtype=cache_dtype
+        )
+        self._rng = jax.random.PRNGKey(seed)
+        self._queue: collections.deque = collections.deque()
+        self._slots: List[Optional[_Slot]] = [None] * num_slots
+        self._next_id = 0
+        self._prefill_traces = 0
+        self._decode_traces = 0
+
+        sp = self.sampling
+
+        def _sample(rng, logits):
+            return sample(
+                rng,
+                logits,
+                temperature=sp.temperature,
+                top_k=sp.top_k,
+                top_p=sp.top_p,
+            )
+
+        def _prefill(params, cache, tokens, slot, length, rng):
+            # trace-time side effect: counts COMPILES, not calls
+            self._prefill_traces += 1
+            sub = cache.slot_view(slot)
+            sub = sub.replace(lengths=jnp.zeros((1,), jnp.int32))
+            logits, sub = model.apply(params, tokens, cache=sub)
+            # the model advanced by the PADDED width; the live prefix
+            # is the real prompt — decode overwrites the pad positions
+            # one by one and never attends past `lengths`
+            sub = sub.replace(
+                lengths=jnp.reshape(length, (1,)).astype(jnp.int32)
+            )
+            cache = cache.write_back(slot, sub)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], length - 1, 0, keepdims=False
+            )
+            first_tok = _sample(rng, last[None, :])[0]
+            return first_tok, cache
+
+        def _decode(params, cache, tokens, active, rng):
+            self._decode_traces += 1
+            logits, new_cache = model.apply(
+                params, tokens[:, None], cache=cache
+            )
+            # pin inactive slots' lengths (their dead-row writes land
+            # in junk the next prefill overwrites, but unbounded drift
+            # would saturate the clamp)
+            new_cache = new_cache.replace(
+                lengths=jnp.where(
+                    active, new_cache.lengths, cache.lengths
+                )
+            )
+            tok = _sample(rng, logits[:, -1, :])
+            return jnp.where(active, tok, 0), new_cache
+
+        # cache buffers are DONATED: the step updates them in place on
+        # TPU. CPU (the test platform) cannot donate and would warn on
+        # every call, so donation is gated on the backend.
+        donate = (1,) if on_tpu() else ()
+        self._prefill_jit = jax.jit(_prefill, donate_argnums=donate)
+        self._decode_jit = jax.jit(_decode, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    @property
+    def num_slots(self) -> int:
+        return len(self._slots)
+
+    @property
+    def num_active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def num_queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def prefill_trace_count(self) -> int:
+        return self._prefill_traces
+
+    @property
+    def decode_trace_count(self) -> int:
+        return self._decode_traces
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or self.num_active > 0
+
+    def add_request(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        request_id: Optional[int] = None,
+    ) -> int:
+        """Queue a prompt; returns the request id. The request is
+        admitted into a cache slot (prefilled) by a later `step` when
+        a slot is free."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds max_prompt_len "
+                f"{self.max_prompt_len} (chunked prefill is a future PR)"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if request_id is None:
+            request_id = self._next_id
+        self._next_id = max(self._next_id, request_id) + 1
+        self._queue.append(Request(request_id, prompt, max_new_tokens))
+        return request_id
+
+    def step(self) -> List[GenerationResult]:
+        """One engine tick: admit queued requests into free slots
+        (one compiled prefill each), then ONE compiled decode step for
+        the whole slot grid. Returns the requests that finished this
+        tick (their slots are already free for the next)."""
+        finished: List[GenerationResult] = []
+
+        # ---- admit ----------------------------------------------------
+        for slot in range(self.num_slots):
+            if self._slots[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            toks = np.zeros((1, self.max_prompt_len), np.int32)
+            toks[0, : len(req.prompt)] = req.prompt
+            self._rng, rng = jax.random.split(self._rng)
+            with profiler.annotate(
+                "inference/prefill", slot=slot, prompt_len=len(req.prompt)
+            ):
+                tok, self.cache = self._prefill_jit(
+                    self.params, self.cache, jnp.asarray(toks),
+                    slot, len(req.prompt), rng,
+                )
+            state = _Slot(
+                req=req, generated=[int(tok)], pos=len(req.prompt)
+            )
+            done = self._finish_reason(state)
+            if done is not None:
+                finished.append(self._evict(slot, state, done))
+            else:
+                self._slots[slot] = state
+
+        # ---- decode ---------------------------------------------------
+        active = np.array(
+            [s is not None for s in self._slots], dtype=bool
+        )
+        if active.any():
+            tokens = np.array(
+                [s.generated[-1] if s is not None else 0
+                 for s in self._slots],
+                np.int32,
+            )
+            self._rng, rng = jax.random.split(self._rng)
+            with profiler.annotate(
+                "inference/decode", batch=int(active.sum())
+            ):
+                tok, self.cache = self._decode_jit(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(active), rng,
+                )
+            toks = np.asarray(tok)
+            for slot, state in enumerate(self._slots):
+                if state is None:
+                    continue
+                state.pos += 1  # the input token was written this step
+                state.generated.append(int(toks[slot]))
+                done = self._finish_reason(state)
+                if done is not None:
+                    finished.append(self._evict(slot, state, done))
+        return finished
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+    ) -> List[GenerationResult]:
+        """Convenience batch API: queue every prompt, run the serving
+        loop dry, return results in prompt order."""
+        ids = [self.add_request(p, max_new_tokens) for p in prompts]
+        done = {}
+        while self.has_work():
+            for r in self.step():
+                done[r.request_id] = r
+        return [done[i] for i in ids]
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _finish_reason(self, state: _Slot) -> Optional[str]:
+        if (
+            self.eos_id is not None
+            and state.generated[-1] == self.eos_id
+        ):
+            return "eos"
+        if len(state.generated) >= state.req.max_new_tokens:
+            return "length"
+        if state.pos >= self.capacity:
+            # the next decode would need cache position `pos`; the
+            # slot is full — forced eviction, never a clamped write
+            return "capacity"
+        return None
+
+    def _evict(
+        self, slot: int, state: _Slot, reason: str
+    ) -> GenerationResult:
+        self._slots[slot] = None
+        return GenerationResult(
+            request_id=state.req.request_id,
+            prompt=list(state.req.prompt),
+            tokens=list(state.generated),
+            finish_reason=reason,
+        )

@@ -1,0 +1,183 @@
+"""Preallocated slot-paged KV cache for autoregressive decoding.
+
+The serving-side analogue of the training stack's packed buffers: all
+device memory the decoder will ever touch is allocated ONCE, up front,
+as per-layer ``(num_slots, capacity, heads, head_dim)`` key/value
+buffers plus one ``(num_slots,)`` int32 length vector. A "slot" is a
+fixed batch lane the continuous-batching engine (engine.py) leases to
+one in-flight request at a time; eviction is just the length
+bookkeeping forgetting the slot — the stale keys beyond a new
+request's live prefix are never attended (the decode kernel bounds
+every row at ``lengths``) and are overwritten position by position as
+the new sequence grows.
+
+Writes are per-slot `lax.dynamic_update_slice` at each slot's current
+length — under jit with donated buffers XLA performs them in place, so
+a decode step's cache traffic is O(layers · heads · head_dim) writes
+plus the attention reads, never a copy of the cache itself. bf16 is
+the default cache dtype (the O4/O5 story: matmul operands in bf16,
+fp32 only where accumulation demands it).
+
+The model layer (models/gpt.py) deliberately does NOT import this
+class: it consumes any pytree with ``.k``/``.v``/``.lengths`` and a
+``.replace`` method, so the dependency points inference → models only.
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+__all__ = ["KVCache"]
+
+
+@struct.dataclass
+class KVCache:
+    """Per-layer K/V buffers + per-slot lengths; a jit-friendly pytree.
+
+    ``k``/``v``: tuples (one entry per transformer layer) of
+    ``(num_slots, capacity, heads_local, head_dim)`` arrays.
+    ``lengths``: ``(num_slots,)`` int32 — tokens currently materialized
+    in each slot; also the write offset for the next token and the
+    attention bound (the decode path attends keys
+    ``[0, lengths + t)`` after writing ``t`` new tokens).
+    """
+
+    k: Tuple[jnp.ndarray, ...]
+    v: Tuple[jnp.ndarray, ...]
+    lengths: jnp.ndarray
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        num_layers: int,
+        num_slots: int,
+        capacity: int,
+        num_heads: int,
+        head_dim: int,
+        dtype: Any = jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (num_slots, capacity, num_heads, head_dim)
+        return cls(
+            k=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+            v=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+            lengths=jnp.zeros((num_slots,), jnp.int32),
+        )
+
+    @classmethod
+    def for_model(
+        cls,
+        cfg,
+        num_slots: int,
+        capacity: Optional[int] = None,
+        dtype: Any = None,
+    ) -> "KVCache":
+        """Cache sized for a `GPTConfig`-shaped config (duck-typed:
+        num_layers / num_attention_heads / head_dim /
+        max_position_embeddings / tensor_parallel_size / dtype). Heads
+        are the LOCAL (per-TP-rank) count, matching what
+        `ParallelAttention` writes."""
+        tp = cfg.tensor_parallel_size or 1
+        return cls.create(
+            cfg.num_layers,
+            num_slots,
+            capacity or cfg.max_position_embeddings,
+            cfg.num_attention_heads // tp,
+            cfg.head_dim,
+            dtype if dtype is not None else cfg.dtype,
+        )
+
+    # ------------------------------------------------------------------
+    # shape facts
+    # ------------------------------------------------------------------
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.k)
+
+    @property
+    def num_slots(self) -> int:
+        return self.k[0].shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.k[0].shape[1]
+
+    # ------------------------------------------------------------------
+    # functional updates (all jit-safe)
+    # ------------------------------------------------------------------
+
+    def write(self, layer: int, k_new: jnp.ndarray, v_new: jnp.ndarray
+              ) -> "KVCache":
+        """Write ``(num_slots, t, heads, head_dim)`` new keys/values
+        into ``layer`` at each slot's current length. Does NOT advance
+        ``lengths`` — one model forward writes every layer at the same
+        offsets, then advances once (`advance`)."""
+
+        def _row(buf, new, start):
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype), (start, 0, 0)
+            )
+
+        k = list(self.k)
+        v = list(self.v)
+        k[layer] = jax.vmap(_row)(self.k[layer], k_new, self.lengths)
+        v[layer] = jax.vmap(_row)(self.v[layer], v_new, self.lengths)
+        return self.replace(k=tuple(k), v=tuple(v))
+
+    def advance(self, t: int, active: Optional[jnp.ndarray] = None
+                ) -> "KVCache":
+        """Advance lengths by ``t`` (clamped to capacity; the engine
+        must finish a sequence BEFORE its length hits capacity — the
+        clamp only keeps stale/idle slots from drifting out of
+        bounds). ``active`` masks which slots advance."""
+        new = jnp.minimum(self.lengths + t, self.capacity)
+        if active is not None:
+            new = jnp.where(active, new, self.lengths)
+        return self.replace(lengths=new)
+
+    def reset_slot(self, slot) -> "KVCache":
+        """Free a slot: forget its length. The stale K/V stay in HBM
+        but are unreachable (every read is bounded by lengths) and get
+        overwritten as the next leaseholder grows."""
+        return self.replace(
+            lengths=jax.lax.dynamic_update_slice(
+                self.lengths, jnp.zeros((1,), jnp.int32), (slot,)
+            )
+        )
+
+    def slot_view(self, slot) -> "KVCache":
+        """A single-slot (num_slots == 1) view — the prefill unit. The
+        engine runs one request's prompt through the model against
+        this view, then scatters it back with `write_back`; ``slot``
+        may be a traced int32 (slot choice does not retrace)."""
+        return KVCache(
+            k=tuple(
+                jax.lax.dynamic_slice_in_dim(b, slot, 1, 0) for b in self.k
+            ),
+            v=tuple(
+                jax.lax.dynamic_slice_in_dim(b, slot, 1, 0) for b in self.v
+            ),
+            lengths=jax.lax.dynamic_slice_in_dim(self.lengths, slot, 1, 0),
+        )
+
+    def write_back(self, slot, sub: "KVCache") -> "KVCache":
+        """Scatter a `slot_view` result back into the full cache."""
+        return KVCache(
+            k=tuple(
+                jax.lax.dynamic_update_slice_in_dim(b, s, slot, 0)
+                for b, s in zip(self.k, sub.k)
+            ),
+            v=tuple(
+                jax.lax.dynamic_update_slice_in_dim(b, s, slot, 0)
+                for b, s in zip(self.v, sub.v)
+            ),
+            lengths=jax.lax.dynamic_update_slice_in_dim(
+                self.lengths, sub.lengths, slot, 0
+            ),
+        )

@@ -1,0 +1,89 @@
+"""Token sampling: greedy, temperature, top-k, top-p.
+
+All transforms are pure jnp on ``(..., vocab)`` logits with STATIC
+configuration (python floats/ints), so they trace once inside the
+engine's compiled `decode_step` and never branch on device values.
+Randomness is functional (`jax.random`): a fixed engine seed replays
+the exact token stream — the serving analogue of the training side's
+deterministic functional dropout.
+
+Filters compose in the conventional order (temperature → top-k →
+top-p), matching the sampling stacks of the serving engines this
+reproduces the semantics of.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "greedy",
+    "top_k_logits",
+    "top_p_logits",
+    "sample",
+]
+
+# Large-negative instead of -inf for masked logits: -inf - (-inf) in a
+# downstream shift would NaN; -1e30 survives every softmax/categorical
+# path identically (exp underflows to exactly 0).
+_MASKED = -1e30
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax token ids, int32."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def top_k_logits(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask everything below the k-th largest logit per row."""
+    if k <= 0:
+        raise ValueError(f"top_k must be positive, got {k}")
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _MASKED, logits)
+
+
+def top_p_logits(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest prefix of the
+    probability-sorted vocabulary whose mass reaches ``p``.
+
+    A sorted token is kept iff the mass strictly BEFORE it is < p, so
+    the first token is always kept (even when it alone exceeds p) and
+    the kept set is the minimal one with total mass >= p.
+    """
+    if not 0.0 < p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {p}")
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = mass_before < p
+    # smallest kept logit = the admission threshold
+    thresh = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < thresh, _MASKED, logits)
+
+
+def sample(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jnp.ndarray:
+    """Draw int32 token ids from ``(..., vocab)`` logits.
+
+    ``temperature == 0.0`` is exact greedy (no rng consumed on the
+    value path — the draw is bypassed at trace time). Config is static:
+    changing it recompiles the caller, which is the engine's contract
+    (sampling params are fixed per engine).
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return greedy(logits)
+    logits = logits / float(temperature)
+    if top_k is not None:
+        logits = top_k_logits(logits, int(top_k))
+    if top_p is not None and top_p < 1.0:
+        logits = top_p_logits(logits, float(top_p))
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -222,7 +222,13 @@ class ParallelAttention(nn.Module):
     attn_mask_type: str = "causal"
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(
+        self,
+        x,
+        attention_mask=None,
+        deterministic: bool = True,
+        cache=None,
+    ):
         cfg = self.cfg
         tp = cfg.tensor_parallel_size or (
             parallel_state.get_tensor_model_parallel_world_size()
@@ -232,6 +238,20 @@ class ParallelAttention(nn.Module):
         nh_local = cfg.num_attention_heads // tp
         hd = cfg.head_dim
         b, sq, _ = x.shape
+
+        # KV-cached inference (cache = per-layer (k_buf, v_buf, lengths)
+        # from the inference package's KVCache): causal only, and
+        # deterministic — decode never sees dropout
+        if cache is not None:
+            if self.attn_mask_type != "causal":
+                raise ValueError(
+                    "KV-cached attention is causal-only "
+                    f"(got attn_mask_type={self.attn_mask_type!r})"
+                )
+            if not deterministic:
+                raise ValueError(
+                    "KV-cached attention requires deterministic=True"
+                )
 
         scale = 1.0 / np.sqrt(hd)
         # in-kernel flash dropout needs the TPU PRNG (no interpret-mode
@@ -268,6 +288,11 @@ class ParallelAttention(nn.Module):
             )
             and cfg.context_parallel_axis is None
             and hd % 128 == 0
+            # cached paths materialize k/v (they must land in the
+            # cache buffers), so the zero-relayout packed kernels —
+            # which read q/k/v straight out of the fused projection —
+            # do not apply; the projection bias stays in the matmul
+            and cache is None
         )
         # packed path: the projection bias rides into the attention
         # kernels (added on tile load; bias-grad partials emitted from
@@ -317,7 +342,87 @@ class ParallelAttention(nn.Module):
                 )
             return jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
 
-        if will_pack:
+        new_kv = None
+        if cache is not None:
+            k_buf, v_buf, lengths = cache
+            q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
+            # write the new keys/values at each slot's current length
+            # (per-row dynamic_update_slice: in place under jit with
+            # donated cache buffers). lengths do NOT advance here —
+            # every layer writes at the same offsets; the transformer
+            # advances once per forward.
+            def _write(buf, new, start):
+                return jax.lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype), (start, 0, 0)
+                )
+
+            k_buf = jax.vmap(_write)(k_buf, k, lengths)
+            v_buf = jax.vmap(_write)(v_buf, v, lengths)
+            new_kv = (k_buf, v_buf)
+            qf = q.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
+            if sq == 1:
+                # single-token decode against the cache: each slot
+                # attends its live prefix [0, lengths + 1) — junk
+                # beyond it (evicted predecessors, prefill padding) is
+                # masked by the per-row bound
+                capacity = k_buf.shape[1]
+                kf = (
+                    k_buf.transpose(0, 2, 1, 3)
+                    .reshape(b * nh_local, capacity, hd)
+                )
+                vf = (
+                    v_buf.transpose(0, 2, 1, 3)
+                    .reshape(b * nh_local, capacity, hd)
+                )
+                kv_len = jnp.repeat(
+                    jnp.minimum(lengths + 1, capacity), nh_local
+                )
+                if cfg.attention_impl == "jnp":
+                    scores = jnp.einsum(
+                        "bqd,bkd->bqk",
+                        qf.astype(jnp.float32),
+                        kf.astype(jnp.float32),
+                    ) * scale
+                    col = jnp.arange(capacity)[None, None, :]
+                    scores = jnp.where(
+                        col < kv_len[:, None, None], scores, -jnp.inf
+                    )
+                    probs = jax.nn.softmax(scores, axis=-1)
+                    ctxf = jnp.einsum(
+                        "bqk,bkd->bqd", probs, vf.astype(jnp.float32)
+                    ).astype(cfg.dtype)
+                else:
+                    from rocm_apex_tpu.ops.flash_attention import (
+                        flash_attention_decode,
+                    )
+
+                    ctxf = flash_attention_decode(qf, kf, vf, kv_len, scale)
+            else:
+                # prefill: slots start empty (lengths == 0), so causal
+                # attention over the fresh window IS the full history —
+                # the cache is written but not read
+                kf = k.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
+                vf = v.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
+                if cfg.attention_impl == "jnp":
+                    scores = jnp.einsum(
+                        "bqd,bkd->bqk",
+                        qf.astype(jnp.float32),
+                        kf.astype(jnp.float32),
+                    ) * scale
+                    mask = ~jnp.tril(jnp.ones((sq, sq), bool))
+                    scores = jnp.where(mask, -jnp.inf, scores)
+                    probs = jax.nn.softmax(scores, axis=-1)
+                    ctxf = jnp.einsum(
+                        "bqk,bkd->bqd", probs, vf.astype(jnp.float32)
+                    ).astype(cfg.dtype)
+                else:
+                    ctxf = flash_attention(qf, kf, vf, None, True, scale)
+            ctx = (
+                ctxf.reshape(b, nh_local, sq, hd)
+                .transpose(0, 2, 1, 3)
+                .reshape(b, sq, nh_local * hd)
+            )
+        elif will_pack:
             pk_causal = self.attn_mask_type == "causal"
             if qkv_bias is None:
                 # use_bias=False projection: the unbiased packed ops
@@ -463,6 +568,8 @@ class ParallelAttention(nn.Module):
             axis_name=cfg.tensor_axis,
             name="dense",
         )(ctx)
+        if cache is not None:
+            return y, new_kv
         return y
 
 
@@ -494,6 +601,7 @@ class ParallelTransformerLayer(nn.Module):
         deterministic: bool = True,
         delta=None,
         chain: bool = False,
+        cache=None,
     ):
         cfg = self.cfg
         if (delta is not None or chain) and (
@@ -501,6 +609,10 @@ class ParallelTransformerLayer(nn.Module):
         ):
             raise ValueError(
                 "residual chaining requires the pre-LN variant"
+            )
+        if cache is not None and (delta is not None or chain):
+            raise ValueError(
+                "KV-cached inference does not use residual chaining"
             )
         # on TPU, hidden dropout rides the residual-LN kernels: the
         # producing site hands its delta UNdropped to the consuming LN
@@ -525,8 +637,11 @@ class ParallelTransformerLayer(nn.Module):
             # inside the LN kernel
             ln1, x = ln1_mod(delta.astype(x.dtype), residual=x)
         attn = ParallelAttention(cfg, self.attn_mask_type, name="self_attention")(
-            ln1, attention_mask, deterministic
+            ln1, attention_mask, deterministic, cache
         )
+        new_kv = None
+        if cache is not None:
+            attn, new_kv = attn
         if cfg.hidden_dropout > 0.0 and not ln_drop:
             attn = _Dropout(cfg.hidden_dropout, cfg.context_parallel_axis)(
                 attn, deterministic=deterministic
@@ -560,7 +675,10 @@ class ParallelTransformerLayer(nn.Module):
         if chain:
             return x.astype(cfg.dtype), mlp.astype(cfg.dtype)
         residual = ln2 if cfg.apply_residual_connection_post_layernorm else x
-        return (residual + mlp.astype(residual.dtype)).astype(cfg.dtype)
+        out = (residual + mlp.astype(residual.dtype)).astype(cfg.dtype)
+        if cache is not None:
+            return out, new_kv
+        return out
 
 
 class ParallelTransformer(nn.Module):
@@ -576,10 +694,18 @@ class ParallelTransformer(nn.Module):
     post_layer_norm: bool = True
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(
+        self,
+        x,
+        attention_mask=None,
+        deterministic: bool = True,
+        cache=None,
+    ):
         n = self.num_layers or self.cfg.num_layers
         layer_cls = ParallelTransformerLayer
-        if self.cfg.checkpoint_activations:
+        # remat is a training memory feature; cached inference never
+        # differentiates, so it skips the rematerialized layer class
+        if self.cfg.checkpoint_activations and cache is None:
             layer_cls = nn.remat(
                 ParallelTransformerLayer, static_argnums=(3, 5)
             )
@@ -589,14 +715,28 @@ class ParallelTransformer(nn.Module):
         # eager adds its residual wiring requires. Under activation
         # checkpointing the chain would carry TWO [b, s, h] residuals
         # per remat boundary instead of one — the bandwidth win is not
-        # worth doubling the memory that mode exists to save
+        # worth doubling the memory that mode exists to save. Cached
+        # decode keeps the plain x→y contract (one token: the adds are
+        # negligible next to the cache-bound attention reads).
         chain = (
             n > 0
             and not self.cfg.apply_residual_connection_post_layernorm
             and not self.cfg.checkpoint_activations
+            and cache is None
         )
         delta = None
+        new_k, new_v = [], []
         for i in range(n):
+            if cache is not None:
+                x, (k_i, v_i) = layer_cls(
+                    self.cfg, self.attn_mask_type, name=f"layer_{i}"
+                )(
+                    x, attention_mask, deterministic, None, False,
+                    (cache.k[i], cache.v[i], cache.lengths),
+                )
+                new_k.append(k_i)
+                new_v.append(v_i)
+                continue
             out = layer_cls(
                 self.cfg, self.attn_mask_type, name=f"layer_{i}"
             )(x, attention_mask, deterministic, delta, chain)
@@ -632,7 +772,18 @@ class ParallelTransformer(nn.Module):
                     self.cfg.hidden_dropout, self.cfg.context_parallel_axis
                 )(delta, deterministic=deterministic)
             x = x + delta.astype(x.dtype)
-        return x.astype(self.cfg.dtype)
+        x = x.astype(self.cfg.dtype)
+        if cache is not None:
+            # every layer wrote at the same offsets; advance ONCE, for
+            # all slots (the engine masks inactive slots afterwards)
+            return x, cache.replace(
+                k=tuple(new_k),
+                v=tuple(new_v),
+                lengths=jnp.minimum(
+                    cache.lengths + x.shape[1], cache.k[0].shape[1]
+                ),
+            )
+        return x
 
 
 class TransformerEmbedding(nn.Module):
@@ -698,6 +849,16 @@ class GPTModel(nn.Module):
     `vocab_parallel_cross_entropy` (or `gpt_loss_fn`). With
     ``labels is not None`` returns per-token losses instead, matching the
     reference's GPT forward.
+
+    ``cache`` opens the inference path: pass a KV cache pytree
+    (``.k``/``.v`` per-layer buffer tuples + ``.lengths``, the protocol
+    of `rocm_apex_tpu.inference.KVCache` — duck-typed so this module
+    never imports the inference package) and the call returns
+    ``(logits, updated_cache)``. Position ids default to each slot's
+    current length; ``tokens`` of width 1 run the single-token decode
+    kernel against the cache, wider windows are prefill (slots must
+    start at length 0). The caller masks which slots' length advances
+    (see inference/engine.py).
     """
 
     cfg: GPTConfig
@@ -714,7 +875,25 @@ class GPTModel(nn.Module):
         labels=None,
         loss_mask=None,
         deterministic: bool = True,
+        cache=None,
     ):
+        if cache is not None:
+            if labels is not None:
+                raise ValueError(
+                    "KV-cached inference returns logits; pass labels "
+                    "only on the training path"
+                )
+            if position_ids is None:
+                # each slot's window continues at its own length
+                position_ids = (
+                    cache.lengths[:, None]
+                    + jnp.arange(tokens.shape[1])[None, :]
+                )
+            x = self.embedding(tokens, position_ids, deterministic)
+            x, cache = self.transformer(
+                x, deterministic=deterministic, cache=cache
+            )
+            return self.embedding.attend(x), cache
         x = self.embedding(tokens, position_ids, deterministic)
         x = self.transformer(x, deterministic=deterministic)
         # Tied head: project with the word-embedding table.

@@ -51,6 +51,18 @@ def _is_plain_bn(norm) -> bool:
     return getattr(norm, "func", None) is nn.BatchNorm
 
 
+def _fold_bn_kwargs(norm) -> dict:
+    """momentum/epsilon the fold must reproduce: the partial's values
+    when given, else flax `nn.BatchNorm`'s OWN defaults (0.99 / 1e-5) —
+    a user partial that omits them must behave identically folded or
+    unfolded, so the fallback cannot be this module's 0.9 preference."""
+    kw = getattr(norm, "keywords", {})
+    return {
+        "momentum": kw.get("momentum", nn.BatchNorm.momentum),
+        "epsilon": kw.get("epsilon", nn.BatchNorm.epsilon),
+    }
+
+
 class FoldedConvBN(nn.Module):
     """1×1 conv + BatchNorm on a no-ReLU edge in ONE pass over the
     input — the projection-shortcut (downsample) fold.
@@ -78,8 +90,11 @@ class FoldedConvBN(nn.Module):
     features: int
     strides: int = 1
     dtype: jnp.dtype = jnp.float32
-    momentum: float = 0.9
-    epsilon: float = 1e-5
+    # defaults mirror flax nn.BatchNorm's own (the module this fold
+    # must be a drop-in for); the ResNet blocks pass their norm
+    # partial's values through _fold_bn_kwargs
+    momentum: float = nn.BatchNorm.momentum
+    epsilon: float = nn.BatchNorm.epsilon
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -179,12 +194,10 @@ class BasicBlock(nn.Module):
                 # the TRAIN step loses ~3 ms net to the fold backward
                 # (xs read twice more + strided-slice materialization)
                 # — BASELINE.md round-5 RN50 section has the numbers
-                kw = getattr(self.norm, "keywords", {})
                 residual = FoldedConvBN(
                     self.filters, self.strides, dtype=self.dtype,
-                    momentum=kw.get("momentum", 0.9),
-                    epsilon=kw.get("epsilon", 1e-5),
                     name="downsample_fold",
+                    **_fold_bn_kwargs(self.norm),
                 )(residual, train)
             else:
                 residual = nn.Conv(
@@ -230,13 +243,10 @@ class Bottleneck(nn.Module):
             if self.fold_downsample and _is_plain_bn(self.norm):
                 # no-ReLU edge: conv + BN in one pass over the input
                 # (opt-in; see BasicBlock note and BASELINE.md)
-                kw = getattr(self.norm, "keywords", {})
                 residual = FoldedConvBN(
                     self.filters * self.expansion, self.strides,
-                    dtype=self.dtype,
-                    momentum=kw.get("momentum", 0.9),
-                    epsilon=kw.get("epsilon", 1e-5),
-                    name="downsample_fold",
+                    dtype=self.dtype, name="downsample_fold",
+                    **_fold_bn_kwargs(self.norm),
                 )(residual, train)
             else:
                 residual = nn.Conv(

@@ -36,6 +36,7 @@ from rocm_apex_tpu.ops._pallas import pallas_call
 __all__ = [
     "flash_attention",
     "flash_attention_varlen",
+    "flash_attention_decode",
     "flash_attention_with_lse",
     "flash_attention_dropout",
     "flash_attention_qkv",
@@ -794,6 +795,125 @@ def _fav_bwd(causal, scale, block_q, block_k, res, do):
 
 
 flash_attention_varlen.defvjp(_fav_fwd, _fav_bwd)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode: forward-only single-token attention
+# ---------------------------------------------------------------------------
+
+
+# Decode queries are one real token padded to ONE input tile of rows
+# (16 covers the bf16 sublane minimum; fp32's 8 divides it) — 8x less
+# MXU work per k block than riding the general forward's 128-row
+# minimum q block.
+DECODE_BLOCK_T = 16
+
+
+def _decode_kernel(
+    scale, sk_real, block_t, block_k,
+    q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr, acc_scr,
+):
+    """Online-softmax decode step for grid point (b, ki). Mirrors
+    `_fwd_kernel`'s accumulation exactly (same `_masked_scores`, same
+    base-2 domain) minus everything decode never needs: causal
+    masking, bias, dropout, lse output, and the backward."""
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = _masked_scores(
+            False, scale, sk_real, block_t, block_k,
+            q, k, None, len_ref, b, jnp.int32(0), ki,
+        )
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot(
+            p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # key blocks wholly past this row's live prefix are skipped — the
+    # preallocated cache tail costs no MXU work for short sequences
+    # (the block DMA still lands; skipping it too needs manual HBM
+    # copies, left for a paged-cache PR)
+    pl.when(ki * block_k < len_ref[b])(_body)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+
+
+def flash_attention_decode(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jnp.ndarray:
+    """Single-token decode attention against a preallocated KV cache.
+
+    ``q`` is (batch*heads, t, head_dim) with t == 1 (the token being
+    decoded); ``k``/``v`` are (batch*heads, capacity, head_dim) cache
+    buffers whose live prefix per row is ``kv_lengths`` (int32,
+    INCLUDING the just-written token — row b attends keys
+    ``[0, kv_lengths[b])``; rows with length 0 emit zeros). Forward
+    only — inference never differentiates — so no lse is saved and no
+    vjp is defined. The q block is one 16-row tile instead of the
+    general kernel's 128, and key blocks past a row's live prefix skip
+    their MXU work entirely.
+    """
+    bh, t, d0 = q.shape
+    if t != 1:
+        raise ValueError(
+            f"flash_attention_decode takes one query token per row "
+            f"(got t={t}); prefill goes through flash_attention"
+        )
+    sk = k.shape[1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d0)
+    d = _round_up(d0, 128)
+    block_t = DECODE_BLOCK_T
+    block_k = min(block_k, _round_up(sk, 128))
+    sk_p = _round_up(sk, block_k)
+    qp = jnp.pad(q, ((0, 0), (0, block_t - t), (0, d - d0)))
+    kp = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d - d0)))
+    vp = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d - d0)))
+
+    o = pallas_call(
+        functools.partial(_decode_kernel, s, sk, block_t, block_k),
+        grid=(bh, sk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_t, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_t, d), lambda b, j: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, block_t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 128), jnp.float32),
+            pltpu.VMEM((block_t, 128), jnp.float32),
+            pltpu.VMEM((block_t, d), jnp.float32),
+        ],
+    )(qp, kp, vp, jnp.asarray(kv_lengths, jnp.int32))
+    return o[:, :t, :d0]
 
 
 # ---------------------------------------------------------------------------

@@ -78,9 +78,9 @@ config = {
 def _compiler_params():
     if config["vmem_limit"] is None:
         return None
-    from jax.experimental.pallas import tpu as pltpu
+    from rocm_apex_tpu.utils.compat import tpu_compiler_params
 
-    return pltpu.CompilerParams(vmem_limit_bytes=config["vmem_limit"])
+    return tpu_compiler_params(vmem_limit_bytes=config["vmem_limit"])
 
 
 def _row_block(m: int, k: int, n: int, itemsize: int = 2,

@@ -56,13 +56,18 @@ __all__ = [
 ]
 
 
-def _block_rows(hidden: int) -> int:
-    return row_block(hidden)
+def _row_tiles(x2d):
+    """Row tiling for a (rows, hidden) operand: (block, padded_x, grid).
 
-
-def _pad_rows(x, block: int):
-    rows = x.shape[0]
-    return pad_rows(x, block), rows
+    ONE definition shared by the forward AND backward pass builders.
+    The in-kernel dropout keep mask is regenerated in the backward from
+    (seed, row-block index) — `_keep_mask` seeded by `pl.program_id` —
+    so a block-size or padding change applied to one pass but not the
+    other would silently hand the backward different keep bits than
+    the forward applied. Any retuning happens here or nowhere."""
+    block = row_block(x2d.shape[1])
+    x_p = pad_rows(x2d, block)
+    return block, x_p, x_p.shape[0] // block
 
 
 # ---------------------------------------------------------------------------
@@ -114,10 +119,8 @@ def _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype,
     out_dtype = out_dtype or x2d.dtype
     affine = weight is not None
     residual = delta2d is not None
-    block = _block_rows(hidden)
-    x_p, _ = _pad_rows(x2d, block)
+    block, x_p, grid = _row_tiles(x2d)
     rows = x_p.shape[0]
-    grid = rows // block
 
     row_spec = pl.BlockSpec((block, hidden), lambda i: (i, 0))
     col_spec = pl.BlockSpec((block, 1), lambda i: (i, 0))
@@ -126,7 +129,7 @@ def _ln_fwd_impl(x2d, delta2d, weight, bias, eps, out_dtype,
     ins = [x_p.astype(kernel_dtype(x_p.dtype))]
     in_specs = [row_spec]
     if residual:
-        r_p, _ = _pad_rows(delta2d, block)
+        r_p = pad_rows(delta2d, block)
         ins.append(r_p.astype(kernel_dtype(r_p.dtype)))
         in_specs.append(row_spec)
     if affine:
@@ -257,11 +260,11 @@ def _layer_norm_bwd(affine, eps, res, dy, ds=None, rate=0.0, seed=None):
     x2d, weight, mu, rs = res
     rows0, hidden = x2d.shape
     has_ds = ds is not None
-    block = _block_rows(hidden)
-    x_p, _ = _pad_rows(x2d, block)
-    dy_p, _ = _pad_rows(dy, block)
+    # the SAME tiling as the forward (see _row_tiles: the dropout mask
+    # regeneration depends on it)
+    block, x_p, grid = _row_tiles(x2d)
+    dy_p = pad_rows(dy, block)
     rows = x_p.shape[0]
-    grid = rows // block
     mu_p = jnp.pad(mu.reshape(-1, 1), ((0, rows - rows0), (0, 0)))
     rs_p = jnp.pad(rs.reshape(-1, 1), ((0, rows - rows0), (0, 0)))
 
@@ -273,7 +276,7 @@ def _layer_norm_bwd(affine, eps, res, dy, ds=None, rate=0.0, seed=None):
     ]
     in_specs = [row_spec, row_spec]
     if has_ds:
-        ds_p, _ = _pad_rows(ds, block)
+        ds_p = pad_rows(ds, block)
         ins.append(ds_p.astype(kernel_dtype(ds_p.dtype)))
         in_specs.append(row_spec)
     ins += [mu_p, rs_p]

@@ -276,7 +276,17 @@ class MixedPrecisionLamb:
       and saves writing+re-reading a 4 B/param u buffer — net −4 B and
       one fewer kernel boundary;
     * ``moment_dtype=bf16`` (optional) halves the m/v traffic and
-      state, the analogue of the reference's fp16-moment modes.
+      state, the analogue of the reference's fp16-moment modes. Numerics
+      caveat — trust-ratio skew: pass A emits ``usq`` (the ratio
+      denominator) from the PRE-rounding fp32 moments in-register,
+      while pass B recomputes the applied ``u`` from the STORED
+      bf16-rounded moments — so with bf16 moments the update direction
+      and the ratio scaling it are ~2⁻⁹-tier inconsistent with each
+      other (and with an fp32-moment run). Accepted as designed: the
+      ratio is one scalar per tensor and checkpoint-replay consistency
+      anchors on pass B's stored moments; runs that must be bitwise-
+      comparable against an fp32-moment baseline need
+      ``moment_dtype=fp32``.
 
     Trust-ratio semantics match `fused_lamb` exactly: ratio =
     ||master||/||u|| for decayed tensors (all tensors with

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = [
     "sync_gradients",
@@ -61,7 +62,7 @@ def group_psum(x, axis_name: str, axis_index_groups: Sequence[Sequence[int]]):
     all_gather plus a static (world × world) membership mask — small
     worlds only, which is what subgroup BN uses.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     mask = np.zeros((world, world), np.float32)
     seen = set()
     for grp in axis_index_groups:
@@ -107,7 +108,7 @@ def sync_gradients(
             raise ValueError("axis_index_groups must have uniform sizes")
         world = sizes.pop()
     else:
-        world = jax.lax.axis_size(axis)
+        world = axis_size(axis)
     pre = 1.0 / gradient_predivide_factor
     post = (
         gradient_predivide_factor / world if gradient_average else 1.0

@@ -31,13 +31,14 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["SyncBatchNorm", "convert_syncbn_model"]
 
 
 def _axis_bound(axis_name: str) -> bool:
     try:
-        jax.lax.axis_size(axis_name)
+        axis_size(axis_name)
         return True
     except NameError:
         return False

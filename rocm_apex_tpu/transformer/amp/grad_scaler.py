@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from rocm_apex_tpu.amp.scaler import LossScaler, ScalerState
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["GradScaler", "sync_found_inf"]
 
@@ -34,7 +35,7 @@ def sync_found_inf(
     out = jnp.asarray(found_inf)
     for ax in axis_names:
         try:
-            jax.lax.axis_size(ax)
+            axis_size(ax)
         except NameError:
             continue
         out = jax.lax.pmax(out.astype(jnp.int32), ax) > 0

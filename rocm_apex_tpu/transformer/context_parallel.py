@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from rocm_apex_tpu.ops.flash_attention import flash_attention_with_lse
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["ring_flash_attention", "ulysses_attention"]
 
@@ -60,7 +61,7 @@ def ring_flash_attention(
     contiguously in axis order (rank r holds tokens
     [r*s_local, (r+1)*s_local)). Returns the local output shard.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     bh, s_loc, dh = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -121,7 +122,7 @@ def ulysses_attention(
     sequence for h/n heads, and the output swaps back. Returns
     (b, s_local, h, d).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, s_loc, h, dh = q.shape
     if h % n:
         raise ValueError(f"num heads {h} not divisible by axis size {n}")

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["SwitchMLP", "switch_route", "load_balancing_loss"]
 
@@ -87,7 +88,7 @@ class SwitchMLP(nn.Module):
         T = xt.shape[0]
         E = self.num_experts
         try:
-            n = jax.lax.axis_size(self.expert_axis)
+            n = axis_size(self.expert_axis)
         except NameError:
             n = 1
         if E % n:

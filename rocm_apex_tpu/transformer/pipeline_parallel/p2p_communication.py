@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = [
     "send_forward",
@@ -62,7 +63,7 @@ def _permute_tree(tree: Any, axis_name: str, perm) -> Any:
 
 
 def _scatter(x, tensor_axis):
-    tp = jax.lax.axis_size(tensor_axis)
+    tp = axis_size(tensor_axis)
     if x.shape[-1] % tp != 0:
         raise ValueError(
             f"scatter_gather transfer needs last dim {x.shape[-1]} divisible "
@@ -105,7 +106,7 @@ def send_forward(
     receives zeros). Combines the reference's send_forward/recv_forward
     pair (p2p_communication.py:188-260) — in SPMD both sides are one op.
     """
-    p = jax.lax.axis_size(axis_name or parallel_state.PIPE_AXIS)
+    p = axis_size(axis_name or parallel_state.PIPE_AXIS)
     return _transfer(
         output_tensor,
         _fwd_perm(p, wrap=False),
@@ -129,7 +130,7 @@ def send_backward(
 ) -> Any:
     """Shift gradients one stage backward (i → i−1); the last stage
     receives zeros. (reference: p2p_communication.py:263-311)."""
-    p = jax.lax.axis_size(axis_name or parallel_state.PIPE_AXIS)
+    p = axis_size(axis_name or parallel_state.PIPE_AXIS)
     return _transfer(
         input_tensor_grad,
         _bwd_perm(p, wrap=False),
@@ -173,7 +174,7 @@ def ring_forward(tree: Any, axis_name: Optional[str] = None, **kw) -> Any:
     """Forward shift with wrap-around (P−1 → 0): the circular-pipeline
     transfer used by the interleaved schedule, where crossing the wrap
     advances the virtual chunk index."""
-    p = jax.lax.axis_size(axis_name or parallel_state.PIPE_AXIS)
+    p = axis_size(axis_name or parallel_state.PIPE_AXIS)
     return _transfer(
         tree,
         _fwd_perm(p, wrap=True),
@@ -184,7 +185,7 @@ def ring_forward(tree: Any, axis_name: Optional[str] = None, **kw) -> Any:
 
 
 def ring_backward(tree: Any, axis_name: Optional[str] = None, **kw) -> Any:
-    p = jax.lax.axis_size(axis_name or parallel_state.PIPE_AXIS)
+    p = axis_size(axis_name or parallel_state.PIPE_AXIS)
     return _transfer(
         tree,
         _bwd_perm(p, wrap=True),

@@ -71,6 +71,7 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size, pcast_varying
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -115,10 +116,10 @@ def _pcast_varying(x, axis):
     Idempotent, and — unlike a raw `pcast(to='varying')`, whose
     transpose is a psum over the axis — the add's transpose passes the
     cotangent through per-rank, so no hidden collective appears in the
-    backward (the schedules do their cross-stage grad sums explicitly)."""
-    z = jax.lax.pcast(
-        jnp.zeros((), jnp.result_type(x)), (axis,), to='varying'
-    )
+    backward (the schedules do their cross-stage grad sums explicitly).
+    (compat.pcast_varying is identity on jax without the replication
+    type system, where nothing needs marking.)"""
+    z = pcast_varying(jnp.zeros((), jnp.result_type(x)), axis)
     return x + z
 
 
@@ -339,7 +340,7 @@ def forward_backward_pipelining_without_interleaving(
             stacklevel=2,
         )
     axis = axis_name or parallel_state.PIPE_AXIS
-    p = jax.lax.axis_size(axis)
+    p = axis_size(axis)
     m = inputs.shape[0]
     ticks = m + p - 1
     rank = jax.lax.axis_index(axis)
@@ -378,12 +379,8 @@ def forward_backward_pipelining_without_interleaving(
             sent = jax.lax.ppermute(y, axis, perm)
             return (sent, y_buf), None
 
-        act0 = jax.lax.pcast(
-            jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
-        )
-        ybuf0 = jax.lax.pcast(
-            jnp.zeros((m,) + a0.shape, a0.dtype), (axis,), to='varying'
-        )
+        act0 = pcast_varying(jnp.zeros(a0.shape, a0.dtype), axis)
+        ybuf0 = pcast_varying(jnp.zeros((m,) + a0.shape, a0.dtype), axis)
         (_, y_buf), _ = jax.lax.scan(tick, (act0, ybuf0), jnp.arange(ticks))
         # post_process on the last stage, once per microbatch
         loss_buf = _head_losses(
@@ -441,7 +438,7 @@ def _one_pass_interleaved(
     1F1B's documented in-flight profile — instead of the O(M·vp)
     carry history of a differentiated scan.
     """
-    p = jax.lax.axis_size(axis)
+    p = axis_size(axis)
     m = inputs.shape[0]
     rank = jax.lax.axis_index(axis)
     is_first = rank == 0
@@ -678,7 +675,7 @@ def forward_backward_pipelining_with_interleaving(
             stacklevel=2,
         )
     axis = axis_name or parallel_state.PIPE_AXIS
-    p = jax.lax.axis_size(axis)
+    p = axis_size(axis)
     m = inputs.shape[0]
     if m % p != 0:
         raise ValueError(
@@ -728,12 +725,8 @@ def forward_backward_pipelining_with_interleaving(
             sent = jax.lax.ppermute(y, axis, ring)
             return (sent, y_buf), None
 
-        act0 = jax.lax.pcast(
-            jnp.zeros(a0.shape, a0.dtype), (axis,), to='varying'
-        )
-        ybuf0 = jax.lax.pcast(
-            jnp.zeros((m,) + a0.shape, a0.dtype), (axis,), to='varying'
-        )
+        act0 = pcast_varying(jnp.zeros(a0.shape, a0.dtype), axis)
+        ybuf0 = pcast_varying(jnp.zeros((m,) + a0.shape, a0.dtype), axis)
         (_, y_buf), _ = jax.lax.scan(tick, (act0, ybuf0), jnp.arange(ticks))
         loss_buf = _head_losses(
             loss_fn, has_extra, extra, y_buf, targets, axis, is_last

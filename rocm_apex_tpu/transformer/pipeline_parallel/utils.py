@@ -18,6 +18,7 @@ from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.transformer.pipeline_parallel.microbatches import (
     build_num_microbatches_calculator,
 )
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = [
     "setup_microbatch_calculator",
@@ -120,14 +121,14 @@ def calc_params_l2_norm(
     bound = []
     for ax in model_axis_names:
         try:
-            jax.lax.axis_size(ax)
+            axis_size(ax)
             bound.append(ax)
         except NameError:
             pass
 
     tp_size = 1.0
     if parallel_state.TENSOR_AXIS in bound:
-        tp_size = jax.lax.axis_size(parallel_state.TENSOR_AXIS)
+        tp_size = axis_size(parallel_state.TENSOR_AXIS)
 
     total = jnp.zeros((), jnp.float32)
     for leaf, is_repl in zip(leaves, repl):

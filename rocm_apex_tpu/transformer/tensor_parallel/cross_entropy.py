@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.transformer.utils import VocabUtility
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["vocab_parallel_cross_entropy"]
 
@@ -34,7 +35,7 @@ def _fwd_impl(vocab_parallel_logits, target, axis_name):
     # of confidently-predicted tokens (p > ~0.998 rounds to 1.0).
     logits_in = vocab_parallel_logits
     logits_f32 = vocab_parallel_logits.astype(jnp.float32)
-    tp = jax.lax.axis_size(axis_name)
+    tp = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     partition_vocab_size = logits_f32.shape[-1]
     start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.transformer import parallel_state
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = [
     "copy_to_tensor_model_parallel_region",
@@ -43,7 +44,7 @@ def _psum(x, axis_name):
 
 def _split_last(x, axis_name):
     """This rank's 1/N chunk of the last dim (reference mappings.py:36-52)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     chunk = x.shape[-1] // n
     if chunk * n != x.shape[-1]:
         raise ValueError(
@@ -58,7 +59,7 @@ def _gather_last(x, axis_name):
 
 
 def _split_first(x, axis_name):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     chunk = x.shape[0] // n
     if chunk * n != x.shape[0]:
         raise ValueError(f"first dim {x.shape[0]} not divisible by axis size {n}")

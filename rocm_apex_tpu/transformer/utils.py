@@ -10,6 +10,7 @@ from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = [
     "ensure_divisibility",
@@ -55,7 +56,7 @@ def split_tensor_into_1d_equal_chunks(tensor: jnp.ndarray, axis_name: str):
     with `axis_name` bound.
     """
     flat = tensor.reshape(-1)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     chunk = divide(flat.shape[0], n)
     rank = jax.lax.axis_index(axis_name)
     return jax.lax.dynamic_slice_in_dim(flat, rank * chunk, chunk, axis=0)

@@ -1,0 +1,50 @@
+"""JAX version-compatibility shims.
+
+The framework targets the moving jax API; the shims here pin the small
+surface that has churned across the versions the CI images carry, so
+version skew breaks ONE module instead of every collective call site.
+"""
+
+import jax
+
+__all__ = ["axis_size", "pcast_varying", "tpu_compiler_params"]
+
+
+def axis_size(axis_name) -> int:
+    """Size of the bound mesh axis ``axis_name`` (a static python int
+    inside shard_map/pmap); raises NameError when the axis is unbound.
+
+    ``jax.lax.axis_size`` only exists on newer jax; on older versions
+    ``lax.psum(1, axis)`` is the documented equivalent — also static,
+    also NameError on unbound names — so behavior is identical on both
+    sides of the version split.
+    """
+    lax_axis_size = getattr(jax.lax, "axis_size", None)
+    if lax_axis_size is not None:
+        return lax_axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axis):
+    """``jax.lax.pcast(x, (axis,), to='varying')`` when the replication
+    type system exists; identity otherwise.
+
+    pcast is a varying/replicated TYPE cast — the value is unchanged —
+    so on jax versions without it (no vma tracking under shard_map)
+    the identity carries the exact same semantics.
+    """
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, (axis,), to="varying")
+
+
+def tpu_compiler_params(**kwargs):
+    """`pltpu.CompilerParams` under its current or pre-rename
+    (`TPUCompilerParams`) name."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

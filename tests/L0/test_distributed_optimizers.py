@@ -264,6 +264,23 @@ class TestAllgatherDtype:
         with pytest.raises(ValueError, match="allgather_dtype"):
             distributed_fused_adam(1e-2, allgather_dtype="fp8")
 
+    def test_default_wire_is_fp32_master_parity(self):
+        """The DEFAULT wire must be the bitwise-exact fp32 gather
+        (round-5 advice: bf16-by-default silently rounded every param
+        every step; the cheap wire is opt-in)."""
+        mesh = data_mesh()
+        params = make_params(jax.random.PRNGKey(14))
+        stacked = per_rank_grads(jax.random.PRNGKey(15), params)
+        dflt = distributed_fused_adam(1e-2, axis_name="data")
+        fp32 = distributed_fused_adam(
+            1e-2, allgather_dtype="fp32", axis_name="data"
+        )
+        for x, y in zip(
+            jax.tree_util.tree_leaves(run_sharded(dflt, params, stacked, mesh)),
+            jax.tree_util.tree_leaves(run_sharded(fp32, params, stacked, mesh)),
+        ):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
     def test_e5m2_wire_saturates_out_of_range_masters(self):
         """Masters beyond e5m2's finite range (57344) must saturate on
         the wire, not overflow to inf and poison the params."""

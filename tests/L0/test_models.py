@@ -269,7 +269,28 @@ class TestFoldedConvBN:
                     momentum=0.9, epsilon=1e-5, name="bn"
                 )(y, use_running_average=not train)
 
-        return FoldedConvBN(24, strides), Composed(24, strides)
+        # hyperparams EXPLICIT on both sides: the fold's class defaults
+        # now mirror flax nn.BatchNorm's (0.99/1e-5), not this test's
+        # composed reference
+        return (
+            FoldedConvBN(24, strides, momentum=0.9, epsilon=1e-5),
+            Composed(24, strides),
+        )
+
+    def test_fold_kwargs_fall_back_to_flax_defaults(self):
+        """A user BN partial that omits momentum/epsilon must fold with
+        flax nn.BatchNorm's OWN defaults (0.99/1e-5), not a hard-coded
+        0.9 — folded and unfolded models must behave identically."""
+        import functools
+        import flax.linen as nn
+        from rocm_apex_tpu.models.resnet import _fold_bn_kwargs
+
+        kw = _fold_bn_kwargs(functools.partial(nn.BatchNorm))
+        assert kw["momentum"] == nn.BatchNorm.momentum == 0.99
+        assert kw["epsilon"] == nn.BatchNorm.epsilon
+        kw = _fold_bn_kwargs(functools.partial(nn.BatchNorm, momentum=0.9))
+        assert kw["momentum"] == 0.9
+        assert kw["epsilon"] == nn.BatchNorm.epsilon
 
     @pytest.mark.parametrize("strides", [1, 2])
     def test_matches_composed_train_eval_and_stats(self, strides):

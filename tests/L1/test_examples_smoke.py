@@ -49,6 +49,13 @@ CASES = [
          "--train-iters", "2", "--log-interval", "1"],
     ),
     ("simple_distributed.py", []),
+    (
+        "generate_gpt.py",
+        ["--num-layers", "2", "--hidden-size", "64",
+         "--num-attention-heads", "4", "--max-seq-len", "64",
+         "--max-prompt-len", "12", "--num-slots", "2",
+         "--num-requests", "5", "--max-new-tokens", "6"],
+    ),
 ]
 
 
