@@ -2,7 +2,11 @@
 
 Measures the flagship config on whatever single chip is available: a
 Megatron-style GPT train step under the O5/amp-O2 recipe — bf16 model
-params computing with Pallas flash attention + fused CE, fp32 masters
+params computing with Pallas flash attention + the chunked fused
+linear+CE LM head (ops/linear_xentropy.py: the (b·s, vocab) logits
+never materialize; `--loss=naive` A/Bs the materialized fp32-logits
+optax path, and the stderr line reports the head's share of the step
+from a standalone fwd+bwd timing of the same op), fp32 masters
 updated by the XLA-tree-fused mixed-precision Adam (optimizers/mixed.py
 — see its header for why tree fusion, not buffer packing, is the TPU
 fast path), dynamic loss scaling with jit-safe skip-step — reporting
@@ -38,7 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from rocm_apex_tpu.amp import LossScaler
-from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
+from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
 from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
 
 BATCH = 16
@@ -598,7 +602,9 @@ def bench_ln():
 
 
 def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
-         remat: bool = False):
+         remat: bool = False, loss: str = "fused"):
+    if loss not in ("fused", "naive"):
+        raise SystemExit(f"--loss must be 'fused' or 'naive', got {loss!r}")
     on_tpu = jax.default_backend() == "tpu"
     default_seq = SEQ if on_tpu else 128
     seq = min(seq or default_seq, default_seq if not on_tpu else 1 << 20)
@@ -643,12 +649,29 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         rng, step_rng = jax.random.split(rng)
 
         def loss_fn(params):
-            losses = model.apply(
-                params, tokens, labels=labels,
-                deterministic=dropout == 0.0,
-                rngs={"dropout": step_rng} if dropout > 0.0 else None,
+            rngs = {"dropout": step_rng} if dropout > 0.0 else None
+            if loss == "naive":
+                # A/B reference: materialize the full (b, s, vocab)
+                # logits, cast fp32, optax CE — the path the model no
+                # longer ships (fused_lm_head + in-op mean reduction)
+                import optax
+
+                logits = model.apply(
+                    params, tokens,
+                    deterministic=dropout == 0.0, rngs=rngs,
+                )
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), labels
+                ).mean()
+                return ce * scaler.loss_scale(sstate)
+            # fused linear-CE head, mean reduction inside the op: the
+            # loss cotangent is a scalar, so the head's dx/dW finish
+            # in the forward pass and no logits ever hit HBM
+            mean = model.apply(
+                params, tokens, labels=labels, loss_reduction="mean",
+                deterministic=dropout == 0.0, rngs=rngs,
             )
-            return gpt_loss_fn(losses) * scaler.loss_scale(sstate)
+            return mean * scaler.loss_scale(sstate)
 
         scaled, grads = jax.value_and_grad(loss_fn)(state.model)
         inv_scale = 1.0 / scaler.loss_scale(sstate)
@@ -674,7 +697,7 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
 
     t0 = time.perf_counter()
     state, sstate, rng0, losses = runN(state, sstate, rng0)
-    loss = float(losses[-1])
+    loss_val = float(losses[-1])
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_sec = batch * seq / dt
@@ -711,13 +734,59 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         suffix += f"_b{batch}"
     if remat:
         suffix += "_remat"
+    if loss != "fused":
+        suffix += f"_loss_{loss}"
+
+    # head share: fwd+bwd of the fused LM head + CE alone, on a bench-
+    # shaped hidden batch against the real tied table — the number the
+    # in-model `jax.named_scope("lm_head_loss")` annotation attributes
+    # in profiles, measured here so BENCH_r*.json records can track it
+    # without a profiler run
+    head_ms = None
+    if loss == "fused":
+        from rocm_apex_tpu.ops.linear_xentropy import (
+            linear_cross_entropy_mean,
+        )
+
+        w_emb = params32["params"]["embedding"]["word_embeddings"][
+            "weight"
+        ].astype(cfg.dtype)
+        hidden0 = jax.random.normal(
+            jax.random.PRNGKey(3), (batch, seq, cfg.hidden_size), cfg.dtype
+        )
+
+        def head_step(carry):
+            h, acc = carry
+            l, (gh, gw) = jax.value_and_grad(
+                lambda h, w: linear_cross_entropy_mean(
+                    h, w, labels, None, cfg.label_smoothing,
+                    cfg.ignore_index, cfg.lm_head_chunk_size,
+                ),
+                (0, 1),
+            )(h, w_emb)
+            # single-column reads force both grads without paying a
+            # full extra sweep inside the timed region
+            tot = (
+                l
+                + jnp.sum(gh[..., 0].astype(jnp.float32))
+                + jnp.sum(gw[:, 0].astype(jnp.float32))
+            )
+            return h + (tot * 1e-30).astype(h.dtype), acc + tot
+
+        head_ms = _timed_scan(head_step, (hidden0, jnp.float32(0)), iters)
+        print(
+            f"lm_head_loss: {head_ms:.2f} ms fwd+bwd "
+            f"({100.0 * head_ms / (dt * 1000):.1f}% of step)",
+            file=sys.stderr,
+        )
     _report(
         f"gpt_train_tokens_per_sec_per_chip{suffix}", tokens_per_sec,
         "tokens/s", mfu / 0.70,
-        f"step={dt*1000:.1f}ms loss={loss:.4f} mfu={mfu:.3f} "
+        f"step={dt*1000:.1f}ms loss={loss_val:.4f} mfu={mfu:.3f} "
         f"(sans-head crediting: {mfu_sans_head:.3f}) "
-        f"dropout={dropout} b={batch} s={seq} remat={remat} "
-        f"backend={jax.default_backend()}",
+        + (f"head={head_ms:.2f}ms " if head_ms is not None else "")
+        + f"dropout={dropout} b={batch} s={seq} remat={remat} "
+        f"loss_impl={loss} backend={jax.default_backend()}",
     )
 
 
@@ -748,6 +817,8 @@ if __name__ == "__main__":
             kwargs["seq"] = int(a.split("=", 1)[1])
         elif a == "--remat":
             kwargs["remat"] = True
+        elif a.startswith("--loss="):
+            kwargs["loss"] = a.split("=", 1)[1]
         elif a.startswith("--fused="):
             kwargs["fused"] = bool(int(a.split("=", 1)[1]))
         elif a.startswith("--"):
@@ -766,6 +837,8 @@ if __name__ == "__main__":
         raise SystemExit("--batch/--remat apply to the gpt/bert benches")
     if "seq" in kwargs and which != "gpt":
         raise SystemExit("--seq applies to the gpt bench")
+    if "loss" in kwargs and which != "gpt":
+        raise SystemExit("--loss applies to the gpt bench")
     if "fused" in kwargs and which != "rn50":
         raise SystemExit("--fused applies to the rn50 bench")
     if kwargs.get("fused") and jax.default_backend() != "tpu":

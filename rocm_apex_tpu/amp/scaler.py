@@ -169,7 +169,16 @@ class LossScaler:
                 overflows=s.overflows,
             )
 
-        new_state = jax.lax.cond(found_inf, on_overflow, on_clean, state)
+        # select between the two branches instead of lax.cond: both are
+        # a handful of scalar ops (evaluating both costs nothing), and
+        # cond inside shard_map trips jax 0.4.37's branch-replication
+        # checker ("mismatched replication types") when found_inf comes
+        # off a collective — e.g. the model-parallel GradScaler's psum
+        new_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(found_inf, a, b),
+            on_overflow(state),
+            on_clean(state),
+        )
         return new_state, found_inf
 
     def loss_scale(self, state: ScalerState) -> jnp.ndarray:

@@ -92,6 +92,22 @@ class GPTConfig:
     # tensor_parallel/random.py:224-293 CheckpointFunction; here it is
     # jax.checkpoint/remat — RNG replay is free with functional PRNG)
     checkpoint_activations: bool = False
+    # LM-head loss semantics (plumbed into both CE paths): label
+    # smoothing epsilon, and the label id whose rows get zero loss and
+    # zero gradient (None = every label contributes)
+    label_smoothing: float = 0.0
+    ignore_index: Optional[int] = None
+    # chunked fused linear+CE head (ops/linear_xentropy.py): the
+    # (b·s, vocab) logits and dlogits never materialize in HBM — per-
+    # chunk tiles are projected, reduced, and contracted back into
+    # dx/dW in one pass. False restores the materialized head
+    # (attend + softmax_cross_entropy_loss_fused), which trades ~2
+    # logits-sized HBM buffers for no chunk-loop/dW-accumulator
+    # overhead — see docs/perf.md for when that wins.
+    fused_lm_head: bool = True
+    # rows per chunk of the fused head (None = the op's default,
+    # chunk*vocab ~ 2^27 elements)
+    lm_head_chunk_size: Optional[int] = None
     # sequence/context parallelism (capability beyond the reference):
     # when set to a bound mesh axis name, the model runs on LOCAL
     # sequence shards — causal attention becomes ring flash attention
@@ -839,6 +855,16 @@ class TransformerEmbedding(nn.Module):
     def attend(self, hidden):
         return self.word_embeddings.attend(hidden)
 
+    def attend_loss(self, hidden, labels, loss_mask=None, reduction=None):
+        """Tied-head projection fused with CE: logits never materialize
+        (`VocabParallelEmbedding.attend_loss`); smoothing/ignore_index
+        come from the config."""
+        cfg = self.cfg
+        return self.word_embeddings.attend_loss(
+            hidden, labels, loss_mask, reduction,
+            cfg.label_smoothing, cfg.ignore_index, cfg.lm_head_chunk_size,
+        )
+
 
 class GPTModel(nn.Module):
     """Embedding → transformer → tied vocab-parallel LM head
@@ -848,7 +874,13 @@ class GPTModel(nn.Module):
     Returns vocab-parallel logits ``(b, s, vocab/tp)``; pair with
     `vocab_parallel_cross_entropy` (or `gpt_loss_fn`). With
     ``labels is not None`` returns per-token losses instead, matching the
-    reference's GPT forward.
+    reference's GPT forward — by default through the chunked fused
+    linear+CE head (``cfg.fused_lm_head``, ops/linear_xentropy.py),
+    which never materializes the ``(b·s, vocab)`` logits.
+    ``loss_reduction="mean"`` additionally folds the
+    `gpt_loss_fn`-style masked mean INTO the fused op, making the loss
+    cotangent a scalar so dx/dW finish inside the forward pass — train
+    steps should prefer it.
 
     ``cache`` opens the inference path: pass a KV cache pytree
     (``.k``/``.v`` per-layer buffer tuples + ``.lengths``, the protocol
@@ -876,6 +908,7 @@ class GPTModel(nn.Module):
         loss_mask=None,
         deterministic: bool = True,
         cache=None,
+        loss_reduction: Optional[str] = None,
     ):
         if cache is not None:
             if labels is not None:
@@ -896,38 +929,69 @@ class GPTModel(nn.Module):
             return self.embedding.attend(x), cache
         x = self.embedding(tokens, position_ids, deterministic)
         x = self.transformer(x, deterministic=deterministic)
-        # Tied head: project with the word-embedding table.
-        logits = self.embedding.attend(x)
         if labels is None:
-            return logits
-        tp = self.cfg.tensor_parallel_size
+            # Tied head: project with the word-embedding table.
+            return self.embedding.attend(x)
+        cfg = self.cfg
+        if loss_reduction not in (None, "mean"):
+            raise ValueError(f"unknown loss_reduction {loss_reduction!r}")
+        if cfg.fused_lm_head:
+            # chunked fused head: the (b·s, vocab) logits/dlogits never
+            # materialize; with loss_reduction="mean" the gradients
+            # finish inside the forward pass (the train fast path)
+            with jax.named_scope("lm_head_loss"):
+                if loss_reduction == "mean":
+                    return self.embedding.attend_loss(
+                        x, labels, loss_mask, "mean"
+                    )
+                losses = self.embedding.attend_loss(x, labels)
+            if loss_mask is not None:
+                losses = losses * loss_mask
+            return losses
+        tp = cfg.tensor_parallel_size
         if tp is None and parallel_state.model_parallel_is_initialized():
             tp = parallel_state.get_tensor_model_parallel_world_size()
-        # logits stay in compute dtype: the CE kernel upcasts per-tile
-        # in VMEM, so casting here would materialize a (b*s, vocab)
-        # fp32 copy in HBM (measured ~12 ms/step on the 134M bench:
-        # 2.1 GB fwd convert + 2.1 GB fp32 dlogits)
-        if (tp or 1) > 1:
-            losses = vocab_parallel_cross_entropy(
-                logits, labels, self.cfg.tensor_axis
-            )
-        else:
-            losses = _serial_cross_entropy(logits, labels)
+        # materialized head: logits stay in compute dtype; the CE
+        # kernel upcasts per-tile in VMEM, so casting here would
+        # materialize a (b*s, vocab) fp32 copy in HBM (measured
+        # ~12 ms/step on the 134M bench: 2.1 GB fwd convert + 2.1 GB
+        # fp32 dlogits)
+        with jax.named_scope("lm_head_loss"):
+            logits = self.embedding.attend(x)
+            if (tp or 1) > 1:
+                if cfg.label_smoothing or cfg.ignore_index is not None:
+                    raise ValueError(
+                        "label_smoothing/ignore_index with tp>1 require "
+                        "fused_lm_head=True (vocab_parallel_cross_entropy "
+                        "has no smoothing/padding support)"
+                    )
+                losses = vocab_parallel_cross_entropy(
+                    logits, labels, cfg.tensor_axis
+                )
+            else:
+                losses = _serial_cross_entropy(
+                    logits, labels, cfg.label_smoothing, cfg.ignore_index
+                )
+        if loss_reduction == "mean":
+            return gpt_loss_fn(losses, loss_mask)
         if loss_mask is not None:
             losses = losses * loss_mask
         return losses
 
 
-def _serial_cross_entropy(logits, labels):
+def _serial_cross_entropy(logits, labels, smoothing=0.0, padding_idx=None):
     """Fused Pallas CE on the (b*s, vocab) view — avoids materializing
-    fp32 logits + log-softmax over the vocabulary (the dominant
-    non-matmul cost of the LM head)."""
+    fp32 logits + log-softmax over the vocabulary. The MATERIALIZED
+    head's loss: the logits tensor already exists; prefer the chunked
+    fused head (`GPTConfig.fused_lm_head` / ops/linear_xentropy.py),
+    which never builds it."""
     b, s, v = logits.shape
     # _fused: differentiation emits dlogits during the forward read of
     # the logits (one pass); the backward is a scalar multiply XLA
     # fuses into the head's dW/dx matmul prologues
     losses = softmax_cross_entropy_loss_fused(
-        logits.reshape(b * s, v), labels.reshape(b * s), 0.0, None
+        logits.reshape(b * s, v), labels.reshape(b * s), smoothing,
+        padding_idx,
     )
     return losses.reshape(b, s)
 
@@ -962,10 +1026,20 @@ def gpt_pipeline_functions(cfg: GPTConfig):
         return layer.apply(stage_params, x)
 
     def loss_fn(extra, hidden, labels):
+        tp = cfg.tensor_parallel_size or 1
+        if cfg.fused_lm_head:
+            # the exit stage gets the same fused treatment as
+            # GPT.__call__: per-chunk logits only, and the dW of the
+            # tied table flows into the embedding (extra) grad through
+            # the op's custom VJP. The mean reduction makes the serial
+            # variant's gradients finish in its forward pass.
+            return embedding.apply(
+                extra, hidden, labels, None, "mean",
+                method=TransformerEmbedding.attend_loss,
+            )
         logits = embedding.apply(
             extra, hidden, method=TransformerEmbedding.attend
         )
-        tp = cfg.tensor_parallel_size or 1
         # compute-dtype logits: both CE paths upcast internally per
         # tile (no fp32 logits copy in HBM)
         if tp > 1:
@@ -973,7 +1047,9 @@ def gpt_pipeline_functions(cfg: GPTConfig):
                 logits, labels, cfg.tensor_axis
             )
         else:
-            losses = _serial_cross_entropy(logits, labels)
+            losses = _serial_cross_entropy(
+                logits, labels, cfg.label_smoothing, cfg.ignore_index
+            )
         return jnp.mean(losses)
 
     return embedding, layer, pre_fn, stage_fn, loss_fn

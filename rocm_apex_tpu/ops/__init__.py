@@ -16,6 +16,7 @@ Modules:
     layer_norm    row-tiled LN fwd/bwd
     softmax       scaled masked / causal softmax
     xentropy      label-smoothing softmax cross-entropy
+    linear_xentropy  chunked fused LM-head + CE (logits never materialize)
     flash_attention  fused attention (contrib fmha/mha superseder)
 """
 

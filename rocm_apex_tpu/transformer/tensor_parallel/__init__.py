@@ -5,6 +5,9 @@ Public surface mirrors the reference package
 shard_map + XLA collectives.
 """
 
+from rocm_apex_tpu.ops.linear_xentropy import (
+    vocab_parallel_linear_cross_entropy,
+)
 from rocm_apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
 )
@@ -49,6 +52,7 @@ from rocm_apex_tpu.transformer.utils import (
 
 __all__ = [
     "vocab_parallel_cross_entropy",
+    "vocab_parallel_linear_cross_entropy",
     "broadcast_data",
     "ColumnParallelLinear",
     "RowParallelLinear",

@@ -162,6 +162,60 @@ class VocabParallelEmbedding(nn.Module):
             preferred_element_type=hidden.dtype,
         )
 
+    def attend_loss(
+        self,
+        hidden: jnp.ndarray,
+        labels: jnp.ndarray,
+        loss_mask: Optional[jnp.ndarray] = None,
+        reduction: Optional[str] = None,
+        smoothing: float = 0.0,
+        padding_idx: Optional[int] = None,
+        chunk_size: Optional[int] = None,
+    ) -> jnp.ndarray:
+        """`attend` fused with cross-entropy: the ``(rows, vocab)``
+        logits never materialize (ops/linear_xentropy.py — the chunked
+        Liger-style head). ``reduction=None`` returns per-row fp32
+        losses shaped like ``labels`` (``loss_mask`` must then be
+        applied by the caller); ``reduction='mean'`` returns the
+        `gpt_loss_fn`-style masked mean scalar, whose gradients finish
+        inside the forward pass (no recompute matmul). The tensor
+        gradient of the tied ``weight`` flows through the fused op, and
+        the hidden gradient is psum'd over the tensor axis internally
+        — no `copy_to_tensor_model_parallel_region` wrapper needed."""
+        from rocm_apex_tpu.ops.linear_xentropy import (
+            linear_cross_entropy_loss,
+            linear_cross_entropy_mean,
+            vocab_parallel_linear_cross_entropy,
+        )
+
+        if reduction not in (None, "mean"):
+            raise ValueError(f"unknown reduction {reduction!r}")
+        tp = _resolve_world_size(self.world_size)
+        w = self.weight.astype(hidden.dtype)
+        if tp == 1:
+            if reduction == "mean":
+                return linear_cross_entropy_mean(
+                    hidden, w, labels, loss_mask,
+                    smoothing, padding_idx, chunk_size,
+                )
+            return linear_cross_entropy_loss(
+                hidden, w, labels, smoothing, padding_idx, chunk_size
+            )
+        _require_axis(self.axis_name, tp, "VocabParallelEmbedding")
+        losses = vocab_parallel_linear_cross_entropy(
+            hidden, w, labels, self.axis_name,
+            smoothing, padding_idx, chunk_size,
+        )
+        if reduction is None:
+            return losses
+        # tp>1 mean: the scalar-cotangent forward-gradient trick needs
+        # a replicated weight, so reduce the per-row fused losses the
+        # gpt_loss_fn way instead
+        if loss_mask is not None:
+            m = jax.lax.stop_gradient(loss_mask).astype(jnp.float32)
+            return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(losses)
+
 
 class ColumnParallelLinear(nn.Module):
     """Linear with the output dimension sharded: Y = XA + b, A split

@@ -1,0 +1,445 @@
+"""Chunked fused linear+CE head (ops/linear_xentropy.py) vs the naive
+fp32 ``x @ W^T`` + optax CE reference.
+
+The contract under test: loss, dx, and dW of the fused head match the
+materializing reference to fp32 tolerance on CPU — including masked
+(`ignore_index`) rows, a loss_mask, label smoothing > 0, non-divisible
+chunk remainders, and a tp=2 vocab-parallel case on the CPU mesh — and
+the ``(rows, vocab)`` logits provably never appear in the jaxpr/HLO.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from rocm_apex_tpu.ops.linear_xentropy import (
+    linear_cross_entropy_loss,
+    linear_cross_entropy_mean,
+    vocab_parallel_linear_cross_entropy,
+)
+
+# remainder-bearing shapes: 37 rows over chunk 8 leaves a 5-row tail
+N, H, V = 37, 16, 50
+CHUNK = 8
+
+
+def _data(seed=0, n=N, v=V, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, H).astype(np.float32)).astype(dtype)
+    w = jnp.asarray((rng.randn(v, H) * 0.1).astype(np.float32)).astype(dtype)
+    y = jnp.asarray(rng.randint(0, v, (n,)).astype(np.int32))
+    return x, w, y
+
+
+def _naive_losses(x, w, y, smoothing=0.0, padding_idx=None):
+    logits = x.astype(jnp.float32) @ w.astype(jnp.float32).T
+    v = w.shape[0]
+    if smoothing > 0.0:
+        tgt = jax.nn.one_hot(y, v) * (1.0 - smoothing) + smoothing / v
+        losses = optax.softmax_cross_entropy(logits, tgt)
+    else:
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    if padding_idx is not None:
+        losses = jnp.where(y == padding_idx, 0.0, losses)
+    return losses
+
+
+class TestSerialPerRow:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_loss_matches_naive(self, smoothing):
+        x, w, y = _data()
+        got = linear_cross_entropy_loss(x, w, y, smoothing, None, CHUNK)
+        ref = _naive_losses(x, w, y, smoothing)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_grads_match_naive(self, smoothing):
+        """dx and dW under an arbitrary per-row cotangent (the backward
+        recomputes each chunk's softmax from the saved lse)."""
+        x, w, y = _data()
+        dl = jnp.asarray(np.random.RandomState(1).randn(N).astype(np.float32))
+
+        gx, gw = jax.grad(
+            lambda x, w: jnp.sum(
+                linear_cross_entropy_loss(x, w, y, smoothing, None, CHUNK)
+                * dl
+            ),
+            (0, 1),
+        )(x, w)
+        rx, rw = jax.grad(
+            lambda x, w: jnp.sum(_naive_losses(x, w, y, smoothing) * dl),
+            (0, 1),
+        )(x, w)
+        np.testing.assert_allclose(
+            np.asarray(gx), np.asarray(rx), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(gw), np.asarray(rw), rtol=1e-5, atol=1e-6
+        )
+
+    def test_ignore_index_rows_zero_loss_and_grad(self):
+        x, w, y = _data()
+        pad = int(y[2])  # several rows share this label
+        masked = np.asarray(y) == pad
+        assert masked.sum() >= 1
+
+        losses = linear_cross_entropy_loss(x, w, y, 0.0, pad, CHUNK)
+        np.testing.assert_array_equal(np.asarray(losses)[masked], 0.0)
+        ref = _naive_losses(x, w, y, padding_idx=pad)
+        np.testing.assert_allclose(
+            np.asarray(losses), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+        gx, gw = jax.grad(
+            lambda x, w: jnp.sum(
+                linear_cross_entropy_loss(x, w, y, 0.0, pad, CHUNK)
+            ),
+            (0, 1),
+        )(x, w)
+        rx, rw = jax.grad(
+            lambda x, w: jnp.sum(_naive_losses(x, w, y, padding_idx=pad)),
+            (0, 1),
+        )(x, w)
+        # masked rows carry exactly zero hidden gradient
+        np.testing.assert_array_equal(np.asarray(gx)[masked], 0.0)
+        np.testing.assert_allclose(
+            np.asarray(gx), np.asarray(rx), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(gw), np.asarray(rw), rtol=1e-5, atol=1e-6
+        )
+
+    def test_leading_shape_and_default_chunk(self):
+        """(b, s) leading shapes flatten internally; the default chunk
+        covers rows in one piece at toy sizes and still matches."""
+        x, w, y = _data()
+        xb = x.reshape(1, N, H)
+        yb = y.reshape(1, N)
+        got = linear_cross_entropy_loss(xb, w, yb)
+        assert got.shape == (1, N)
+        np.testing.assert_allclose(
+            np.asarray(got[0]), np.asarray(_naive_losses(x, w, y)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_bf16_confident_gradient_not_flushed(self):
+        """bf16 inputs with a confidently-predicted target must keep a
+        non-zero target-entry gradient: the softmax is recomputed in
+        fp32 from the saved lse, never stored in bf16 (the
+        vocab_parallel_cross_entropy round-2 bar, held here too)."""
+        x, w, y = _data(dtype=jnp.bfloat16)
+        v = w.shape[0]
+        # push every row's target logit high: p(target) ~ 1
+        w = w.at[:].set(0.01 * w)
+        x = (10.0 * jax.nn.one_hot(y, v) @ w.astype(jnp.float32)).astype(
+            jnp.bfloat16
+        ) + x
+        gx = jax.grad(
+            lambda x: jnp.mean(
+                linear_cross_entropy_loss(x, w, y, 0.0, None, CHUNK)
+            )
+        )(x)
+        assert np.isfinite(np.asarray(gx, np.float32)).all()
+        assert float(jnp.max(jnp.abs(gx.astype(jnp.float32)))) > 0.0
+
+
+class TestMeanVariant:
+    def test_matches_perrow_composition_with_mask(self):
+        """The in-op masked mean equals gpt_loss_fn over the per-row
+        losses — value AND gradients (the forward-gradient fast path
+        must agree with the recompute backward)."""
+        from rocm_apex_tpu.models.gpt import gpt_loss_fn
+
+        x, w, y = _data()
+        mask = jnp.asarray(
+            (np.random.RandomState(2).rand(N) > 0.3).astype(np.float32)
+        )
+
+        def composed(x, w):
+            return gpt_loss_fn(
+                linear_cross_entropy_loss(x, w, y, 0.1, 3, CHUNK), mask
+            )
+
+        def fused(x, w):
+            return linear_cross_entropy_mean(x, w, y, mask, 0.1, 3, CHUNK)
+
+        v1, g1 = jax.value_and_grad(composed, (0, 1))(x, w)
+        v2, g2 = jax.value_and_grad(fused, (0, 1))(x, w)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+    def test_no_mask_plain_mean_vs_naive(self):
+        x, w, y = _data()
+        v1, g1 = jax.value_and_grad(
+            lambda x, w: linear_cross_entropy_mean(
+                x, w, y, None, 0.0, None, CHUNK
+            ),
+            (0, 1),
+        )(x, w)
+        v2, g2 = jax.value_and_grad(
+            lambda x, w: jnp.mean(_naive_losses(x, w, y)), (0, 1)
+        )(x, w)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+
+class TestNoMaterializedLogits:
+    def test_full_logits_absent_from_jaxpr(self):
+        """The acceptance bar made executable: no (rows, vocab)-shaped
+        intermediate exists anywhere in the traced computation — only
+        (chunk, vocab) tiles. The naive reference, traced the same
+        way, does contain it (so the probe itself is sound)."""
+        x, w, y = _data()
+        dl = jnp.ones((N,), jnp.float32)
+
+        def fused_step(x, w):
+            return jnp.sum(
+                linear_cross_entropy_loss(x, w, y, 0.0, None, CHUNK) * dl
+            )
+
+        def naive_step(x, w):
+            return jnp.sum(_naive_losses(x, w, y) * dl)
+
+        full = f"{N},{V}]"
+        chunked = f"{CHUNK},{V}]"
+        fused_ir = str(jax.make_jaxpr(jax.grad(fused_step, (0, 1)))(x, w))
+        naive_ir = str(jax.make_jaxpr(jax.grad(naive_step, (0, 1)))(x, w))
+        assert full in naive_ir  # probe sanity
+        assert full not in fused_ir
+        assert chunked in fused_ir
+
+        def mean_step(x, w):
+            return linear_cross_entropy_mean(x, w, y, None, 0.0, None, CHUNK)
+
+        mean_ir = str(jax.make_jaxpr(jax.grad(mean_step, (0, 1)))(x, w))
+        assert full not in mean_ir
+        assert chunked in mean_ir
+
+
+class TestVocabParallel:
+    TP = 2
+
+    def _mesh(self):
+        devs = jax.devices()
+        if len(devs) < self.TP:
+            pytest.skip(f"needs {self.TP} simulated devices")
+        return Mesh(np.array(devs[: self.TP]), ("tensor",))
+
+    @pytest.mark.parametrize("smoothing,pad", [(0.0, None), (0.1, 3)])
+    def test_matches_naive_tp2(self, smoothing, pad):
+        """Loss, dx, and the gathered dW shards match the serial naive
+        reference; gradients taken INSIDE shard_map (the training
+        idiom of examples/gpt_train.py — with check_rep=False an
+        outside-grad cotangent arrives scaled, like every other TP
+        layer in this package)."""
+        mesh = self._mesh()
+        x, w, y = _data(v=48)  # 48 = 2 x 24 local columns
+        dl = jnp.asarray(
+            np.random.RandomState(3).randn(N).astype(np.float32)
+        )
+
+        def inner(x, w_loc):
+            def loss(x, w_loc):
+                losses = vocab_parallel_linear_cross_entropy(
+                    x, w_loc, y, "tensor", smoothing, pad, CHUNK
+                )
+                return jnp.sum(losses * dl), losses
+
+            (val, losses), (gx, gw) = jax.value_and_grad(
+                loss, (0, 1), has_aux=True
+            )(x, w_loc)
+            return val, losses, gx, gw
+
+        f = jax.jit(
+            shard_map(
+                inner, mesh=mesh, in_specs=(P(), P("tensor")),
+                out_specs=(P(), P(), P(), P("tensor")), check_rep=False,
+            )
+        )
+        val, losses, gx, gw = f(x, w)
+
+        ref = _naive_losses(x, w, y, smoothing, pad)
+        rx, rw = jax.grad(
+            lambda x, w: jnp.sum(
+                _naive_losses(x, w, y, smoothing, pad) * dl
+            ),
+            (0, 1),
+        )(x, w)
+        np.testing.assert_allclose(
+            np.asarray(losses), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(float(val), float(jnp.sum(ref * dl)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(gx), np.asarray(rx), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(gw), np.asarray(rw), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestModelIntegration:
+    def _cfg(self, **kw):
+        from rocm_apex_tpu.models.gpt import GPTConfig
+
+        base = dict(
+            vocab_size=64,
+            hidden_size=32,
+            num_layers=2,
+            num_attention_heads=2,
+            max_position_embeddings=16,
+            hidden_dropout=0.0,
+            attention_dropout=0.0,
+            tensor_parallel_size=1,
+            params_dtype=jnp.float32,
+            dtype=jnp.float32,
+            attention_impl="jnp",
+            use_pallas_softmax=False,
+        )
+        base.update(kw)
+        return GPTConfig(**base)
+
+    def test_fused_head_matches_materialized_head(self):
+        """GPT.__call__'s labeled path: fused_lm_head=True (chunked
+        linear+CE) and False (attend + Pallas CE) agree on per-token
+        losses and on every parameter gradient — including the tied
+        embedding table, whose dW flows through the fused op's custom
+        VJP."""
+        from rocm_apex_tpu.models.gpt import GPTModel, gpt_loss_fn
+
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 16), 0, 64
+        )
+        labels = jnp.roll(tokens, -1, axis=1)
+        m_fused = GPTModel(self._cfg(fused_lm_head=True, lm_head_chunk_size=8))
+        m_mat = GPTModel(self._cfg(fused_lm_head=False))
+        params = m_fused.init(jax.random.PRNGKey(1), tokens)
+
+        lf = jax.jit(
+            lambda p: m_fused.apply(p, tokens, labels=labels)
+        )(params)
+        lm = jax.jit(lambda p: m_mat.apply(p, tokens, labels=labels))(params)
+        np.testing.assert_allclose(
+            np.asarray(lf), np.asarray(lm), rtol=1e-5, atol=1e-6
+        )
+
+        gf = jax.jit(
+            jax.grad(
+                lambda p: gpt_loss_fn(m_fused.apply(p, tokens, labels=labels))
+            )
+        )(params)
+        gm = jax.jit(
+            jax.grad(
+                lambda p: gpt_loss_fn(m_mat.apply(p, tokens, labels=labels))
+            )
+        )(params)
+        for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(gf),
+            jax.tree_util.tree_leaves_with_path(gm),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6,
+                err_msg=jax.tree_util.keystr(ka),
+            )
+
+    def test_mean_reduction_matches_composed(self):
+        """loss_reduction='mean' (scalar-cotangent fast path) equals
+        gpt_loss_fn over the per-token path, with a loss_mask."""
+        from rocm_apex_tpu.models.gpt import GPTModel, gpt_loss_fn
+
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+        labels = jnp.roll(tokens, -1, axis=1)
+        mask = (
+            jax.random.uniform(jax.random.PRNGKey(3), (2, 16)) > 0.3
+        ).astype(jnp.float32)
+        model = GPTModel(self._cfg(lm_head_chunk_size=8))
+        params = model.init(jax.random.PRNGKey(4), tokens)
+
+        v1, g1 = jax.jit(
+            jax.value_and_grad(
+                lambda p: model.apply(
+                    p, tokens, labels=labels, loss_mask=mask,
+                    loss_reduction="mean",
+                )
+            )
+        )(params)
+        v2, g2 = jax.jit(
+            jax.value_and_grad(
+                lambda p: gpt_loss_fn(
+                    model.apply(p, tokens, labels=labels), mask
+                )
+            )
+        )(params)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+
+    def test_pipeline_loss_fn_fused_matches_materialized(self):
+        """gpt_pipeline_functions.loss_fn (the pipeline exit stage)
+        through the fused head equals the materialized head's mean CE,
+        value and embedding gradients — the tied table's dW flows into
+        the extra (embedding) grad."""
+        from rocm_apex_tpu.models.gpt import gpt_pipeline_functions
+
+        cfg_f = self._cfg(lm_head_chunk_size=8)
+        cfg_m = self._cfg(fused_lm_head=False)
+        emb, _, _, _, loss_f = gpt_pipeline_functions(cfg_f)
+        _, _, _, _, loss_m = gpt_pipeline_functions(cfg_m)
+        tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0, 64)
+        labels = jnp.roll(tokens, -1, axis=1)
+        e_params = emb.init(jax.random.PRNGKey(8), tokens)
+        hidden = jax.random.normal(
+            jax.random.PRNGKey(9), (2, 16, 32), jnp.float32
+        )
+        vf, gf = jax.value_and_grad(
+            lambda e, h: loss_f(e, h, labels), (0, 1)
+        )(e_params, hidden)
+        vm, gm = jax.value_and_grad(
+            lambda e, h: loss_m(e, h, labels), (0, 1)
+        )(e_params, hidden)
+        np.testing.assert_allclose(float(vf), float(vm), rtol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gm)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+
+    def test_config_smoothing_and_ignore_index_reachable(self):
+        """label_smoothing/ignore_index on GPTConfig actually reach the
+        kernel: the labeled path equals the naive reference computed
+        from the model's own logits."""
+        from rocm_apex_tpu.models.gpt import GPTModel
+
+        cfg = self._cfg(label_smoothing=0.1, ignore_index=5)
+        model = GPTModel(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, 64)
+        labels = jnp.roll(tokens, -1, axis=1)
+        params = model.init(jax.random.PRNGKey(6), tokens)
+        losses = jax.jit(
+            lambda p: model.apply(p, tokens, labels=labels)
+        )(params)
+        logits = jax.jit(lambda p: model.apply(p, tokens))(params)
+        tgt = jax.nn.one_hot(labels, 64) * 0.9 + 0.1 / 64
+        ref = optax.softmax_cross_entropy(logits.astype(jnp.float32), tgt)
+        ref = jnp.where(labels == 5, 0.0, ref)
+        np.testing.assert_allclose(
+            np.asarray(losses), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )

@@ -231,8 +231,11 @@ class TestSyncBatchNorm:
         bn = SyncBatchNorm(axis_name=None, channel_last=True)
         vars_ = bn.init(jax.random.PRNGKey(1), x, use_running_average=False)
         y = bn.apply(vars_, x, use_running_average=True)
-        # fresh stats are mean=0 var=1 -> identity (affine is identity too)
-        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
+        # fresh stats are mean=0 var=1 -> identity up to the epsilon in
+        # the denominator: y = x/sqrt(1+eps) scales x by ~eps/2 = 5e-6,
+        # which puts |y-x| at 1.2e-5 for the |x|~2.5 draws in this key
+        # (ISSUE 2 triage: the old atol=1e-5 sat under the eps term)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=3e-5)
 
     def test_fuse_relu(self):
         x = jax.random.normal(jax.random.PRNGKey(5), (32, 3))

@@ -164,6 +164,61 @@ def test_loader_unit(tmp_path):
         np.testing.assert_array_equal(ya, yb)
 
 
+def test_gpt_fused_head_train_step():
+    """A small-config GPT train step through the chunked fused
+    linear+CE head (the bench recipe: loss_reduction="mean" + the
+    mixed-precision Adam), IN-PROCESS on the CPU mesh: two real
+    optimizer steps, finite decreasing loss, and the tied embedding
+    table actually learns (its grad flows through the fused op's
+    custom VJP, not through materialized logits)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
+    from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
+
+    cfg = GPTConfig(
+        vocab_size=128,
+        hidden_size=64,
+        num_layers=2,
+        num_attention_heads=4,
+        max_position_embeddings=32,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        tensor_parallel_size=1,
+        params_dtype=jnp.float32,
+        dtype=jnp.float32,
+        lm_head_chunk_size=16,
+    )
+    model = GPTModel(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 128)
+    labels = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(1), tokens)
+    opt = MixedPrecisionAdam(1e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(state):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.apply(
+                p, tokens, labels=labels, loss_reduction="mean"
+            )
+        )(state.model)
+        state2, _ = opt.step_and_probe(state, grads)
+        return state2, loss, grads
+
+    state, l0, grads = step(state)
+    emb_g = grads["params"]["embedding"]["word_embeddings"]["weight"]
+    assert float(jnp.sum(jnp.abs(emb_g))) > 0.0
+    losses = [float(l0)]
+    for _ in range(4):
+        state, loss, _ = step(state)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
 def test_loader_producer_error_surfaces(tmp_path):
     """A corrupt sample must RAISE in the consumer, not hang the
     training loop on a dead producer (round-5 review finding)."""
