@@ -18,6 +18,14 @@ residual-LN kernels): the config users train is the config the driver
 gate records (round-5 change; `--dropout=0` measures the eval-shaped
 config under the un-suffixed metric key).
 
+`--seq-parallel` A/Bs the tp-axis configuration: the model shards over
+ALL visible chips on the tensor axis with sequence-parallel
+activations between the TP boundaries (GPTConfig.sequence_parallel);
+`--collective-matmul` additionally decomposes the boundary collectives
+into ppermute-ring matmuls (ops/collective_matmul.py). These emit
+`_sp_tpN` / `_spcm_tpN`-suffixed metric keys so the tp-axis step-time
+series stays separate from the dp bench above.
+
 Timing notes:
 * ITERS steps run inside ONE dispatch via `lax.scan` — the axon tunnel
   adds tens of ms of per-dispatch latency that real multi-step training
@@ -602,10 +610,20 @@ def bench_ln():
 
 
 def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
-         remat: bool = False, loss: str = "fused"):
+         remat: bool = False, loss: str = "fused",
+         seq_parallel: bool = False, collective_matmul: bool = False):
     if loss not in ("fused", "naive"):
         raise SystemExit(f"--loss must be 'fused' or 'naive', got {loss!r}")
+    if collective_matmul and not seq_parallel:
+        raise SystemExit("--collective-matmul requires --seq-parallel")
     on_tpu = jax.default_backend() == "tpu"
+    # tp-axis A/B: shard the model over ALL visible chips on the
+    # tensor axis with sequence-parallel activations between the TP
+    # boundaries; --collective-matmul additionally fuses the boundary
+    # collectives into ppermute-ring matmuls (ops/collective_matmul).
+    # On a one-chip host the flags still run (identity collectives) so
+    # the code path and the distinct metric key are exercised.
+    tp = len(jax.devices()) if seq_parallel else 1
     default_seq = SEQ if on_tpu else 128
     seq = min(seq or default_seq, default_seq if not on_tpu else 1 << 20)
     # long-context configs shrink the batch to fit and pay ITERS down
@@ -627,10 +645,19 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         max_position_embeddings=seq if on_tpu else 128,
         hidden_dropout=dropout,
         attention_dropout=dropout,
-        tensor_parallel_size=1,
+        tensor_parallel_size=tp,
+        sequence_parallel=seq_parallel,
+        collective_matmul=collective_matmul,
         checkpoint_activations=remat,
     )
     seq = min(seq, cfg.max_position_embeddings)
+
+    mesh = None
+    if tp > 1:
+        from rocm_apex_tpu.transformer import parallel_state
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(tp, 1)
 
     model = GPTModel(cfg)
     opt = MixedPrecisionAdam(1e-4, weight_decay=0.01)
@@ -639,7 +666,23 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
-    params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
+    if mesh is not None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        # sharded init: each rank draws its own weight shards (rank-
+        # folded init); the batch is replicated over the tensor axis
+        def local_init(tokens):
+            return model.init(jax.random.PRNGKey(1), tokens)
+
+        params32 = jax.jit(
+            shard_map(
+                local_init, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                check_rep=False,
+            )
+        )(tokens[:1])
+    else:
+        params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
     state = opt.init(params32)
     sstate = scaler.init()
     rng0 = _dropout_rng0(dropout, on_tpu)
@@ -683,14 +726,25 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         sstate2, _ = scaler.update(sstate, found_inf)
         return (state2, sstate2, rng), scaled * inv_scale
 
-    @jax.jit
-    def runN(state, sstate, rng):
+    def local_runN(state, sstate, rng):
         # unroll=2 halves the while-loop bookkeeping between steps
         # (measured -0.9 ms/step) at the cost of one extra body compile
         (state, sstate, rng), losses = jax.lax.scan(
             one_step, (state, sstate, rng), None, length=iters, unroll=2
         )
         return state, sstate, rng, losses
+
+    if mesh is not None:
+        runN = jax.jit(
+            shard_map(
+                local_runN, mesh=mesh,
+                in_specs=(P(), P(), P()),
+                out_specs=(P(), P(), P(), P()),
+                check_rep=False,
+            )
+        )
+    else:
+        runN = jax.jit(local_runN)
 
     state, sstate, rng0, losses = runN(state, sstate, rng0)
     float(losses[-1])  # warmup + sync (value fetch, not block_until_ready)
@@ -701,9 +755,29 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_sec = batch * seq / dt
-    n_params = sum(
-        int(x.size) for x in jax.tree_util.tree_leaves(params32)
-    ) - cfg.vocab_size * cfg.hidden_size
+    count_tree = params32
+    if tp > 1:
+        # sharded leaves report local shapes; count the full model
+        # from an abstract tp=1 init (eval_shape: no compute)
+        import dataclasses
+        import math
+
+        cfg_count = dataclasses.replace(
+            cfg, tensor_parallel_size=1, sequence_parallel=False,
+            collective_matmul=False,
+        )
+        count_tree = jax.eval_shape(
+            lambda t: GPTModel(cfg_count).init(jax.random.PRNGKey(1), t),
+            tokens[:1],
+        )
+        n_params = sum(
+            int(math.prod(x.shape))
+            for x in jax.tree_util.tree_leaves(count_tree)
+        ) - cfg.vocab_size * cfg.hidden_size
+    else:
+        n_params = sum(
+            int(x.size) for x in jax.tree_util.tree_leaves(count_tree)
+        ) - cfg.vocab_size * cfg.hidden_size
     # Model FLOPs, Megatron-style (Narayanan et al. 2021, the logit-
     # layer term of their eq. 3; PaLM appendix B counts it the same
     # way): 6·N over the non-embedding params, + the attention scores/
@@ -717,11 +791,14 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         + 12.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
         + 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size
     )
-    mfu = (model_flops / dt) / peak_flops_per_chip()
+    mfu = (model_flops / dt) / (peak_flops_per_chip() * tp)
     mfu_sans_head = (
         (model_flops - 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size)
         / dt
-    ) / peak_flops_per_chip()
+    ) / (peak_flops_per_chip() * tp)
+    # per-chip normalization: the tp-sharded step spreads the same
+    # global batch over tp chips
+    tokens_per_sec = tokens_per_sec / tp
     # the driver's BASELINE series must never mix configs under one
     # key. The dropout suffix keys on the VALUE, not the default:
     # dropout 0.1 became the default in round 5, and its rows must
@@ -736,14 +813,21 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         suffix += "_remat"
     if loss != "fused":
         suffix += f"_loss_{loss}"
+    if seq_parallel:
+        # the tp-axis series gets its own keys: _sp (blocking
+        # sequence-parallel collectives) vs _spcm (ring collective
+        # matmuls), never mixed with the dp series above
+        suffix += ("_spcm" if collective_matmul else "_sp") + f"_tp{tp}"
 
     # head share: fwd+bwd of the fused LM head + CE alone, on a bench-
     # shaped hidden batch against the real tied table — the number the
     # in-model `jax.named_scope("lm_head_loss")` annotation attributes
     # in profiles, measured here so BENCH_r*.json records can track it
-    # without a profiler run
+    # without a profiler run. Skipped under --seq-parallel: the tied
+    # table is then a vocab shard per rank and the standalone replay
+    # would measure a different (1/tp) head.
     head_ms = None
-    if loss == "fused":
+    if loss == "fused" and tp == 1:
         from rocm_apex_tpu.ops.linear_xentropy import (
             linear_cross_entropy_mean,
         )
@@ -786,7 +870,13 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         f"(sans-head crediting: {mfu_sans_head:.3f}) "
         + (f"head={head_ms:.2f}ms " if head_ms is not None else "")
         + f"dropout={dropout} b={batch} s={seq} remat={remat} "
-        f"loss_impl={loss} backend={jax.default_backend()}",
+        f"loss_impl={loss} backend={jax.default_backend()}"
+        + (
+            f" seq_parallel=True collective_matmul={collective_matmul} "
+            f"tp={tp}"
+            if seq_parallel
+            else ""
+        ),
     )
 
 
@@ -817,6 +907,10 @@ if __name__ == "__main__":
             kwargs["seq"] = int(a.split("=", 1)[1])
         elif a == "--remat":
             kwargs["remat"] = True
+        elif a == "--seq-parallel":
+            kwargs["seq_parallel"] = True
+        elif a == "--collective-matmul":
+            kwargs["collective_matmul"] = True
         elif a.startswith("--loss="):
             kwargs["loss"] = a.split("=", 1)[1]
         elif a.startswith("--fused="):
@@ -839,6 +933,12 @@ if __name__ == "__main__":
         raise SystemExit("--seq applies to the gpt bench")
     if "loss" in kwargs and which != "gpt":
         raise SystemExit("--loss applies to the gpt bench")
+    if (
+        "seq_parallel" in kwargs or "collective_matmul" in kwargs
+    ) and which != "gpt":
+        raise SystemExit(
+            "--seq-parallel/--collective-matmul apply to the gpt bench"
+        )
     if "fused" in kwargs and which != "rn50":
         raise SystemExit("--fused applies to the rn50 bench")
     if kwargs.get("fused") and jax.default_backend() != "tpu":

@@ -64,6 +64,14 @@ def main():
         # activations_checkpoint_method='uniform' (reference semantics)
         checkpoint_activations=args.activations_checkpoint_method
         is not None,
+        # --sequence-parallel shards the inter-boundary activations
+        # over the tensor axis; --collective-matmul rides only if the
+        # reference's async-allreduce opt-out was not given
+        sequence_parallel=args.sequence_parallel,
+        collective_matmul=(
+            args.collective_matmul
+            and args.async_tensor_model_parallel_allreduce
+        ),
     )
     model = GPTModel(cfg)
     opt = MixedPrecisionAdam(args.lr, weight_decay=args.weight_decay)

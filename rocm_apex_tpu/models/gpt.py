@@ -42,6 +42,8 @@ from rocm_apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear,
     RowParallelLinear,
     VocabParallelEmbedding,
+    gather_from_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
 )
 from rocm_apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
@@ -114,6 +116,32 @@ class GPTConfig:
     # over the axis and position embeddings offset by the shard start.
     # Requires attention_impl="flash" and contiguous axis-order sharding.
     context_parallel_axis: Optional[str] = None
+    # Megatron-style sequence parallelism over the TENSOR axis
+    # (Korthikanti et al.): activations between the column→row TP
+    # pairs — layernorms, dropout, residual stream — hold 1/tp of the
+    # sequence; the TP-edge collectives become all-gather (entry) and
+    # reduce-scatter (exit) on the sequence dim. Unlike
+    # context_parallel_axis this reuses the TP ranks (no extra mesh
+    # axis) and attention still sees the full sequence; the two cannot
+    # compose (both shard the sequence dim).
+    sequence_parallel: bool = False
+    # fuse the sequence-parallel edge collectives into the adjacent
+    # matmuls as ppermute-chunked rings (ops/collective_matmul.py,
+    # arXiv 2305.06942): each ICI hop hides under a partial matmul and
+    # the gathered (b, s, h) activation never materializes.
+    collective_matmul: bool = False
+    # ring piece size in rows (None = one piece per shard; a chunk
+    # that does not tile the shard falls back to the plain collective)
+    collective_matmul_chunk: Optional[int] = None
+
+    def __post_init__(self):
+        if self.sequence_parallel and self.context_parallel_axis is not None:
+            raise ValueError(
+                "sequence_parallel shards the sequence over the tensor "
+                "axis and context_parallel_axis shards it over "
+                f"{self.context_parallel_axis!r}: the axes collide on "
+                "the sequence dimension — enable one or the other"
+            )
 
     @property
     def ffn_size(self) -> int:
@@ -127,6 +155,30 @@ class GPTConfig:
 
 def _init(cfg: GPTConfig):
     return nn.initializers.normal(stddev=cfg.init_method_std)
+
+
+def _resolve_tp(cfg: GPTConfig) -> int:
+    return cfg.tensor_parallel_size or (
+        parallel_state.get_tensor_model_parallel_world_size()
+        if parallel_state.model_parallel_is_initialized()
+        else 1
+    )
+
+
+def _sp_active(cfg: GPTConfig, tp: int) -> bool:
+    return cfg.sequence_parallel and tp > 1
+
+
+def _sp_kwargs(cfg: GPTConfig, tp: int) -> dict:
+    """Constructor kwargs routing the sequence-parallel / collective-
+    matmul config into a Column/RowParallelLinear."""
+    if not _sp_active(cfg, tp):
+        return {}
+    return dict(
+        sequence_parallel=True,
+        collective_matmul=cfg.collective_matmul,
+        collective_matmul_chunk=cfg.collective_matmul_chunk,
+    )
 
 
 class _Dropout(nn.Module):
@@ -151,6 +203,28 @@ class _Dropout(nn.Module):
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
         keep = jax.random.bernoulli(rng, 1.0 - self.rate, x.shape)
         return jnp.where(keep, x / (1.0 - self.rate), 0.0).astype(x.dtype)
+
+
+def _ln_sync_axis(cfg: GPTConfig) -> Optional[str]:
+    """LN affine params are replicated but, under sequence parallelism,
+    normalize shard-local rows — their grads psum over the tensor axis
+    (MixedFusedLayerNorm.grad_sync_axis)."""
+    return (
+        cfg.tensor_axis if _sp_active(cfg, _resolve_tp(cfg)) else None
+    )
+
+
+def _hidden_dropout_mod(cfg: GPTConfig) -> "_Dropout":
+    """Hidden-dropout module with the shard axes folded in: the
+    context axis for CP shards, the tensor axis under sequence
+    parallelism (the hidden stream is a sequence shard there too)."""
+    return _Dropout(
+        cfg.hidden_dropout,
+        cfg.context_parallel_axis,
+        tp_axis=(
+            cfg.tensor_axis if _sp_active(cfg, _resolve_tp(cfg)) else None
+        ),
+    )
 
 
 def _scaled_init(cfg: GPTConfig):
@@ -180,13 +254,17 @@ def _use_ln_dropout(cfg: GPTConfig, deterministic: bool) -> bool:
 
 def _hidden_dropout_seed(mod: nn.Module, cfg: GPTConfig):
     """Per-site int32 scalar seed for the in-kernel hidden dropout;
-    folds the context-parallel rank so sequence shards draw
-    independent masks (the _Dropout cp_axis rule)."""
+    folds the context-parallel rank — and the tensor rank under
+    sequence parallelism, where the hidden stream is also a sequence
+    shard — so shards draw independent masks (the _Dropout axis
+    rule)."""
     rng = mod.make_rng("dropout")
     if cfg.context_parallel_axis is not None:
         rng = jax.random.fold_in(
             rng, jax.lax.axis_index(cfg.context_parallel_axis)
         )
+    if _sp_active(cfg, _resolve_tp(cfg)):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(cfg.tensor_axis))
     return jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
 
 
@@ -199,6 +277,7 @@ class ParallelMLP(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         cfg = self.cfg
+        sp_kw = _sp_kwargs(cfg, _resolve_tp(cfg))
         h, _ = ColumnParallelLinear(
             cfg.hidden_size,
             cfg.ffn_size,
@@ -209,6 +288,7 @@ class ParallelMLP(nn.Module):
             world_size=cfg.tensor_parallel_size,
             axis_name=cfg.tensor_axis,
             name="dense_h_to_4h",
+            **sp_kw,
         )(x)
         h = nn.gelu(h)
         y, _ = RowParallelLinear(
@@ -221,6 +301,7 @@ class ParallelMLP(nn.Module):
             world_size=cfg.tensor_parallel_size,
             axis_name=cfg.tensor_axis,
             name="dense_4h_to_h",
+            **sp_kw,
         )(h)
         return y
 
@@ -254,6 +335,17 @@ class ParallelAttention(nn.Module):
         nh_local = cfg.num_attention_heads // tp
         hd = cfg.head_dim
         b, sq, _ = x.shape
+        sp = _sp_active(cfg, tp)
+        if sp:
+            # x is the local sequence shard; the QKV projection's
+            # internal all-gather restores the full sequence, which is
+            # what every attention path below operates on
+            if cache is not None:
+                raise ValueError(
+                    "sequence_parallel does not compose with KV-cached "
+                    "inference (the cache holds full sequences)"
+                )
+            sq = sq * tp
 
         # KV-cached inference (cache = per-layer (k_buf, v_buf, lengths)
         # from the inference package's KVCache): causal only, and
@@ -324,6 +416,7 @@ class ParallelAttention(nn.Module):
             world_size=cfg.tensor_parallel_size,
             axis_name=cfg.tensor_axis,
             name="query_key_value",
+            **_sp_kwargs(cfg, tp),
         )(x)
         qkv = qkv.reshape(b, sq, nh_local, 3 * hd)
         if cfg.context_parallel_axis is not None and (
@@ -583,6 +676,7 @@ class ParallelAttention(nn.Module):
             world_size=cfg.tensor_parallel_size,
             axis_name=cfg.tensor_axis,
             name="dense",
+            **_sp_kwargs(cfg, tp),
         )(ctx)
         if cache is not None:
             return y, new_kv
@@ -636,7 +730,8 @@ class ParallelTransformerLayer(nn.Module):
         # chained MLP delta), which drops it in-kernel
         ln_drop = _use_ln_dropout(cfg, deterministic)
         ln1_mod = MixedFusedLayerNorm(
-            cfg.hidden_size, eps=cfg.layernorm_epsilon, name="input_layernorm"
+            cfg.hidden_size, eps=cfg.layernorm_epsilon,
+            grad_sync_axis=_ln_sync_axis(cfg), name="input_layernorm"
         )
         if delta is None:
             ln1 = ln1_mod(x)
@@ -659,12 +754,13 @@ class ParallelTransformerLayer(nn.Module):
         if cache is not None:
             attn, new_kv = attn
         if cfg.hidden_dropout > 0.0 and not ln_drop:
-            attn = _Dropout(cfg.hidden_dropout, cfg.context_parallel_axis)(
+            attn = _hidden_dropout_mod(cfg)(
                 attn, deterministic=deterministic
             )
         ln2_mod = MixedFusedLayerNorm(
             cfg.hidden_size,
             eps=cfg.layernorm_epsilon,
+            grad_sync_axis=_ln_sync_axis(cfg),
             name="post_attention_layernorm",
         )
         if cfg.apply_residual_connection_post_layernorm:
@@ -685,7 +781,7 @@ class ParallelTransformerLayer(nn.Module):
         if cfg.hidden_dropout > 0.0 and not (ln_drop and chain):
             # unchained exits add the delta eagerly (no LN kernel to
             # ride), so the MLP dropout stays standalone there
-            mlp = _Dropout(cfg.hidden_dropout, cfg.context_parallel_axis)(
+            mlp = _hidden_dropout_mod(cfg)(
                 mlp, deterministic=deterministic
             )
         if chain:
@@ -768,6 +864,7 @@ class ParallelTransformer(nn.Module):
             lnf = MixedFusedLayerNorm(
                 self.cfg.hidden_size,
                 eps=self.cfg.layernorm_epsilon,
+                grad_sync_axis=_ln_sync_axis(self.cfg),
                 name="final_layernorm",
             )
             if chain and ln_drop:
@@ -784,9 +881,9 @@ class ParallelTransformer(nn.Module):
             if ln_drop:
                 # no final LN to ride: the pending delta's dropout
                 # falls back to the standalone path
-                delta = _Dropout(
-                    self.cfg.hidden_dropout, self.cfg.context_parallel_axis
-                )(delta, deterministic=deterministic)
+                delta = _hidden_dropout_mod(self.cfg)(
+                    delta, deterministic=deterministic
+                )
             x = x + delta.astype(x.dtype)
         x = x.astype(self.cfg.dtype)
         if cache is not None:
@@ -828,9 +925,7 @@ class TransformerEmbedding(nn.Module):
             (cfg.max_position_embeddings, cfg.hidden_size),
             cfg.params_dtype,
         )
-        self.dropout = _Dropout(
-            cfg.hidden_dropout, cfg.context_parallel_axis
-        )
+        self.dropout = _hidden_dropout_mod(cfg)
 
     def __call__(self, tokens, position_ids=None, deterministic: bool = True):
         cfg = self.cfg
@@ -848,6 +943,13 @@ class TransformerEmbedding(nn.Module):
             cfg.dtype
         )
         x = words + pos
+        if _sp_active(cfg, _resolve_tp(cfg)):
+            # sequence-parallel region entry: scatter BEFORE dropout so
+            # the mask (and everything downstream until the LM-head
+            # gather) holds 1/tp of the rows
+            x = scatter_to_sequence_parallel_region(
+                x, cfg.tensor_axis, dim=1
+            )
         if cfg.hidden_dropout > 0.0:
             x = self.dropout(x, deterministic=deterministic)
         return x
@@ -881,6 +983,13 @@ class GPTModel(nn.Module):
     `gpt_loss_fn`-style masked mean INTO the fused op, making the loss
     cotangent a scalar so dx/dW finish inside the forward pass — train
     steps should prefer it.
+
+    ``cfg.sequence_parallel``: the embedding scatters the sequence
+    over the tensor axis and the stack runs on ``(b, s/tp, h)``
+    shards; the one full-sequence activation is the LM-head input,
+    gathered here at the region exit. ``cfg.collective_matmul``
+    additionally fuses every TP-edge collective into a ppermute-ring
+    matmul (ops/collective_matmul.py) — see docs/parallel.md.
 
     ``cache`` opens the inference path: pass a KV cache pytree
     (``.k``/``.v`` per-layer buffer tuples + ``.lengths``, the protocol
@@ -916,6 +1025,11 @@ class GPTModel(nn.Module):
                     "KV-cached inference returns logits; pass labels "
                     "only on the training path"
                 )
+            if self.cfg.sequence_parallel:
+                raise ValueError(
+                    "sequence_parallel does not compose with KV-cached "
+                    "inference (the cache holds full sequences)"
+                )
             if position_ids is None:
                 # each slot's window continues at its own length
                 position_ids = (
@@ -929,6 +1043,20 @@ class GPTModel(nn.Module):
             return self.embedding.attend(x), cache
         x = self.embedding(tokens, position_ids, deterministic)
         x = self.transformer(x, deterministic=deterministic)
+        if _sp_active(self.cfg, _resolve_tp(self.cfg)):
+            # sequence-parallel region exit: the LM head needs full
+            # rows (the vocab is sharded over the SAME tensor axis, so
+            # a rank cannot score its local rows against remote vocab
+            # shards). This is the one full-sequence activation of the
+            # step — everything between embedding scatter and here ran
+            # on 1/tp of the rows. tensor_parallel_output_grad=False:
+            # the head's internal vjp already psums the hidden grad, so
+            # the cotangent here is full and replicated — the backward
+            # takes this rank's slice.
+            x = gather_from_sequence_parallel_region(
+                x, self.cfg.tensor_axis, dim=1,
+                tensor_parallel_output_grad=False,
+            )
         if labels is None:
             # Tied head: project with the word-embedding table.
             return self.embedding.attend(x)
@@ -1020,13 +1148,37 @@ def gpt_pipeline_functions(cfg: GPTConfig):
     layer = ParallelTransformerLayer(cfg)
 
     def pre_fn(extra, tokens):
+        # under cfg.sequence_parallel the embedding scatters the
+        # sequence before returning, so every stage (and the p2p hops
+        # between them) carries the 1/tp shard
         return embedding.apply(extra, tokens)
 
     def stage_fn(stage_params, x):
         return layer.apply(stage_params, x)
 
     def loss_fn(extra, hidden, labels):
-        tp = cfg.tensor_parallel_size or 1
+        # parallel_state-aware tp: the embedding pre_fn resolves it the
+        # same way, so scatter and gather can never disagree
+        tp = _resolve_tp(cfg)
+        if _sp_active(cfg, tp):
+            # exit stage: gather the sequence shard before the head —
+            # the vocab-parallel head scores full rows against the
+            # local vocab shard, over the SAME tensor axis
+            hidden = gather_from_sequence_parallel_region(
+                hidden, cfg.tensor_axis, dim=1,
+                tensor_parallel_output_grad=False,
+            )
+        if hidden.shape[:2] != labels.shape[:2]:
+            raise ValueError(
+                f"pipeline exit stage: hidden rows {hidden.shape[:2]} "
+                f"!= labels rows {tuple(labels.shape[:2])}. With "
+                "sequence_parallel the exit stage must receive the "
+                "1/tp sequence SHARD and gather it before the head; a "
+                "mismatch here means the stages and the loss disagree "
+                "about which axis shards the sequence (e.g. the stack "
+                "was built with a different tensor_parallel_size, or "
+                "the sequence axis collides with another mesh axis)"
+            )
         if cfg.fused_lm_head:
             # the exit stage gets the same fused treatment as
             # GPT.__call__: per-chunk logits only, and the dW of the

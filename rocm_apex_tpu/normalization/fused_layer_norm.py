@@ -15,9 +15,11 @@ Both compute statistics in fp32 regardless of storage dtype, like the
 reference kernels.
 """
 
-from typing import Sequence, Tuple, Union
+from functools import partial
+from typing import Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -151,6 +153,26 @@ def mixed_dtype_fused_layer_norm_residual_affine(
     return y.reshape(orig), s.reshape(orig)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_grad(x, axis_name):
+    """Identity forward / psum backward: when the LN input is a shard
+    (sequence parallelism), each rank's affine-param grad is a partial
+    row sum and must reduce over the axis — the functional form of
+    Megatron's `allreduce_sequence_parallel_gradients` hook."""
+    return x
+
+
+def _psum_grad_fwd(x, axis_name):
+    return x, None
+
+
+def _psum_grad_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+_psum_grad.defvjp(_psum_grad_fwd, _psum_grad_bwd)
+
+
 class MixedFusedLayerNorm(nn.Module):
     """flax module mirroring `MixedFusedLayerNorm`: always affine, output
     dtype follows the (fp32) params even for bf16/fp16 inputs
@@ -167,6 +189,10 @@ class MixedFusedLayerNorm(nn.Module):
     normalized_shape: Shape
     eps: float = 1e-5
     param_dtype: jnp.dtype = jnp.float32
+    # set to the mesh axis the input rows are sharded over (sequence
+    # parallelism): the weight/bias grads — partial sums over the
+    # local rows — psum over it in backward; forward is unchanged
+    grad_sync_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, residual=None, dropout_rate: float = 0.0,
@@ -178,6 +204,9 @@ class MixedFusedLayerNorm(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros_init(), shape, self.param_dtype
         )
+        if self.grad_sync_axis is not None:
+            weight = _psum_grad(weight, self.grad_sync_axis)
+            bias = _psum_grad(bias, self.grad_sync_axis)
         if residual is not None:
             return mixed_dtype_fused_layer_norm_residual_affine(
                 residual, x, weight, bias, shape, self.eps,

@@ -18,6 +18,8 @@ Modules:
     xentropy      label-smoothing softmax cross-entropy
     linear_xentropy  chunked fused LM-head + CE (logits never materialize)
     flash_attention  fused attention (contrib fmha/mha superseder)
+    collective_matmul  ppermute-ring all-gather/reduce-scatter matmuls
+                  (latency-hiding TP boundaries, arXiv 2305.06942)
 """
 
 from rocm_apex_tpu.ops.packing import PackedTree, pack_tree, unpack_tree  # noqa: F401

@@ -13,11 +13,26 @@ modules holding the *local shard* of each weight, meant to run inside
   reference layers.py:78-124) — no master-weight scatter is needed since
   every rank derives its shard deterministically;
 * the async-allreduce fused autograd function
-  (reference layers.py:206-240) has no analogue: XLA's latency-hiding
-  scheduler overlaps the backward psum with the weight-gradient matmul
-  automatically, so ``no_async_tensor_model_parallel_allreduce`` is
-  accepted for API parity and ignored;
+  (reference layers.py:206-240) maps onto two mechanisms: XLA's
+  latency-hiding scheduler overlaps the backward psum with the weight-
+  gradient matmul on its own, and the ``sequence_parallel`` +
+  ``collective_matmul`` fields below replace the blocking TP-edge
+  collectives with the ppermute-chunked rings of
+  `rocm_apex_tpu.ops.collective_matmul` (arXiv 2305.06942).
+  ``no_async_tensor_model_parallel_allreduce=True`` — the reference's
+  opt-out of comm/compute overlap — disables the collective-matmul
+  path (see docs/migration.md);
 * ``use_cpu_initialization`` is meaningless (init is a traced function).
+
+With ``sequence_parallel=True`` (Korthikanti et al. semantics) the
+activations OUTSIDE the column→row pair are sharded along the
+rows/sequence axis (``-2``) of the tensor axis: ColumnParallelLinear
+takes the local sequence shard and all-gathers it into the matmul
+(``gather_output`` must be False), RowParallelLinear reduce-scatters
+its output back to a shard (``input_is_parallel`` must be True), so
+everything between the pair (layernorm, dropout, residual) holds
+``1/tp`` of the rows. ``collective_matmul=True`` fuses those edge
+collectives into the matmuls as rings.
 
 For the GSPMD path (pjit + sharding annotations instead of shard_map) use
 the same modules with ``world_size=1`` and annotate the full weights —
@@ -30,6 +45,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from rocm_apex_tpu.ops.collective_matmul import (
+    all_gather_matmul,
+    matmul_reduce_scatter,
+)
 from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.transformer.tensor_parallel import mappings
 from rocm_apex_tpu.transformer.utils import VocabUtility, divide
@@ -228,6 +247,17 @@ class ColumnParallelLinear(nn.Module):
 
     Returns ``(output, output_bias)`` exactly like the reference; when
     ``skip_bias_add=False`` output_bias is None.
+
+    ``sequence_parallel``: the input is the local rows-shard of the
+    activation (sharded on axis ``-2`` over the tensor axis); the
+    forward all-gathers it into the matmul and the backward reduce-
+    scatters the input grad — the Megatron sequence-parallel region
+    entry. Requires ``gather_output=False``. ``collective_matmul``
+    replaces the blocking gather with the ppermute-chunked ring of
+    `ops.collective_matmul.all_gather_matmul` (the gathered activation
+    never materializes); ``collective_matmul_chunk`` sets the ring
+    piece size in rows (None = one piece per shard; a non-tiling chunk
+    falls back to the plain collective).
     """
 
     input_size: int
@@ -241,8 +271,13 @@ class ColumnParallelLinear(nn.Module):
     dtype: jnp.dtype = jnp.float32
     world_size: Optional[int] = None
     axis_name: str = parallel_state.TENSOR_AXIS
-    # Accepted for API parity; XLA overlaps the backward psum on its own
-    # (reference layers.py:206-240, 296-300).
+    sequence_parallel: bool = False
+    collective_matmul: bool = False
+    collective_matmul_chunk: Optional[int] = None
+    # The reference's opt-out of its fused async comm/compute overlap
+    # (layers.py:206-240, 296-300): here it disables the collective-
+    # matmul ring, restoring the blocking lax collective at this edge
+    # (XLA still overlaps the backward psum on its own).
     no_async_tensor_model_parallel_allreduce: bool = False
 
     @nn.compact
@@ -250,6 +285,11 @@ class ColumnParallelLinear(nn.Module):
         tp = _resolve_world_size(self.world_size)
         if tp > 1:
             _require_axis(self.axis_name, tp, "ColumnParallelLinear")
+        if self.sequence_parallel and self.gather_output:
+            raise ValueError(
+                "sequence_parallel=True shards the rows the caller "
+                "sees; it requires gather_output=False"
+            )
         out_per_partition = divide(self.output_size, tp)
         kernel = self.param(
             "kernel",
@@ -268,13 +308,39 @@ class ColumnParallelLinear(nn.Module):
             else None
         )
 
-        if tp > 1:
-            x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
-        y = jnp.dot(
-            x.astype(self.dtype),
-            kernel.astype(self.dtype),
-            preferred_element_type=self.dtype,
-        )
+        if tp > 1 and self.sequence_parallel:
+            # region entry: the sequence shard gathers INTO the matmul
+            # (ring when collective_matmul); the backward is the
+            # transposed reduce-scatter, so no copy/psum wrapper
+            if (
+                self.collective_matmul
+                and not self.no_async_tensor_model_parallel_allreduce
+            ):
+                y = all_gather_matmul(
+                    x.astype(self.dtype),
+                    kernel.astype(self.dtype),
+                    self.axis_name,
+                    self.collective_matmul_chunk,
+                )
+            else:
+                xg = mappings.gather_from_sequence_parallel_region(
+                    x, self.axis_name, dim=-2
+                )
+                y = jnp.dot(
+                    xg.astype(self.dtype),
+                    kernel.astype(self.dtype),
+                    preferred_element_type=self.dtype,
+                )
+        else:
+            if tp > 1:
+                x = mappings.copy_to_tensor_model_parallel_region(
+                    x, self.axis_name
+                )
+            y = jnp.dot(
+                x.astype(self.dtype),
+                kernel.astype(self.dtype),
+                preferred_element_type=self.dtype,
+            )
         out_bias = None
         if bias is not None:
             if self.skip_bias_add:
@@ -298,6 +364,15 @@ class RowParallelLinear(nn.Module):
     ``input_is_parallel`` skips the input scatter when the producer was a
     ColumnParallelLinear with gather_output=False (layers.py:378-381).
     Bias is added after the reduction, once (layers.py:461-470).
+
+    ``sequence_parallel``: the output psum becomes a reduce-scatter
+    over the rows axis (``-2``) — the Megatron sequence-parallel
+    region exit; the caller receives the local rows-shard and the
+    bias is added once per row on the shard. Requires
+    ``input_is_parallel=True``. ``collective_matmul`` fuses the
+    reduce-scatter into the matmul as the ppermute-chunked ring of
+    `ops.collective_matmul.matmul_reduce_scatter` (the full-rows
+    pre-reduce product never materializes).
     """
 
     input_size: int
@@ -311,12 +386,21 @@ class RowParallelLinear(nn.Module):
     dtype: jnp.dtype = jnp.float32
     world_size: Optional[int] = None
     axis_name: str = parallel_state.TENSOR_AXIS
+    sequence_parallel: bool = False
+    collective_matmul: bool = False
+    collective_matmul_chunk: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         tp = _resolve_world_size(self.world_size)
         if tp > 1:
             _require_axis(self.axis_name, tp, "RowParallelLinear")
+        if self.sequence_parallel and not self.input_is_parallel:
+            raise ValueError(
+                "sequence_parallel=True requires input_is_parallel=True "
+                "(the producer must be a ColumnParallelLinear with "
+                "gather_output=False)"
+            )
         in_per_partition = divide(self.input_size, tp)
         kernel = self.param(
             "kernel",
@@ -334,13 +418,36 @@ class RowParallelLinear(nn.Module):
 
         if tp > 1 and not self.input_is_parallel:
             x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
-        y = jnp.dot(
-            x.astype(self.dtype),
-            kernel.astype(self.dtype),
-            preferred_element_type=self.dtype,
-        )
-        if tp > 1:
-            y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
+        if tp > 1 and self.sequence_parallel and self.collective_matmul:
+            # region exit: partial products consumed piecewise by the
+            # rotating accumulator ring — the full-rows pre-reduce
+            # product never materializes
+            y = matmul_reduce_scatter(
+                x.astype(self.dtype),
+                kernel.astype(self.dtype),
+                self.axis_name,
+                self.collective_matmul_chunk,
+            )
+        else:
+            y = jnp.dot(
+                x.astype(self.dtype),
+                kernel.astype(self.dtype),
+                preferred_element_type=self.dtype,
+            )
+            if tp > 1 and self.sequence_parallel:
+                y = mappings.reduce_scatter_to_sequence_parallel_region(
+                    y, self.axis_name, dim=-2
+                )
+            elif tp > 1:
+                y = mappings.reduce_from_tensor_model_parallel_region(
+                    y, self.axis_name
+                )
+        if tp > 1 and self.sequence_parallel and bias is not None:
+            # the replicated bias lands on shard-local rows: its grad
+            # is a partial row sum — identity fwd, psum bwd
+            bias = mappings.copy_to_tensor_model_parallel_region(
+                bias, self.axis_name
+            )
         out_bias = None
         if bias is not None:
             if self.skip_bias_add:

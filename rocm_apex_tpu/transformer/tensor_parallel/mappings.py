@@ -58,17 +58,21 @@ def _gather_last(x, axis_name):
     return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
 
 
-def _split_first(x, axis_name):
+def _split_dim(x, axis_name, dim):
+    dim = dim % x.ndim
     n = axis_size(axis_name)
-    chunk = x.shape[0] // n
-    if chunk * n != x.shape[0]:
-        raise ValueError(f"first dim {x.shape[0]} not divisible by axis size {n}")
+    chunk = x.shape[dim] // n
+    if chunk * n != x.shape[dim]:
+        raise ValueError(
+            f"dim {dim} of size {x.shape[dim]} not divisible by axis "
+            f"size {n}"
+        )
     rank = jax.lax.axis_index(axis_name)
-    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=0)
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
 
 
-def _gather_first(x, axis_name):
-    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+def _gather_dim(x, axis_name, dim):
+    return jax.lax.all_gather(x, axis_name, axis=dim % x.ndim, tiled=True)
 
 
 # -- copy: identity fwd / allreduce bwd --------------------------------
@@ -158,57 +162,83 @@ gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
 #
 # Capability the reference lacks (SURVEY.md §5: no sequence parallelism);
 # included because it falls out of the same design: activations sharded
-# along the sequence (first) dim between transformer-layer regions, with
+# along the sequence dim between transformer-layer regions, with
 # reduce_scatter/all_gather replacing the plain psum at region edges
-# (Korthikanti et al., "Reducing Activation Recomputation").
+# (Korthikanti et al., "Reducing Activation Recomputation"). ``dim``
+# selects the sharded dimension: 0 (the Megatron [s, b, h] convention)
+# by default, 1 for this package's [b, s, h] activations. For the
+# ring-overlapped fusion of these edges with the adjacent matmuls see
+# `rocm_apex_tpu.ops.collective_matmul`.
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def scatter_to_sequence_parallel_region(x, axis_name=None):
-    return _split_first(x, _axis(axis_name))
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_sequence_parallel_region(x, axis_name=None, dim=0):
+    return _split_dim(x, _axis(axis_name), dim)
 
 
-def _sp_scatter_fwd(x, axis_name):
-    return _split_first(x, _axis(axis_name)), None
+def _sp_scatter_fwd(x, axis_name, dim):
+    return _split_dim(x, _axis(axis_name), dim), None
 
 
-def _sp_scatter_bwd(axis_name, _, g):
-    return (_gather_first(g, _axis(axis_name)),)
+def _sp_scatter_bwd(axis_name, dim, _, g):
+    return (_gather_dim(g, _axis(axis_name), dim),)
 
 
 scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def gather_from_sequence_parallel_region(x, axis_name=None):
-    return _gather_first(x, _axis(axis_name))
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def gather_from_sequence_parallel_region(
+    x, axis_name=None, dim=0, tensor_parallel_output_grad=True
+):
+    """All-gather the sequence shards. ``tensor_parallel_output_grad``
+    picks the transpose by what CONSUMES the gathered tensor (the
+    Megatron flag of the same name): True when it feeds tensor-parallel
+    computation (a column-parallel matmul — each rank's cotangent is a
+    distinct partial, so the backward reduce-scatters); False when it
+    feeds the replicated stream (the LM-head input — the cotangent is
+    already full and identical on every rank, so the backward just
+    takes this rank's slice; a reduce-scatter there would overcount
+    by the axis size)."""
+    return _gather_dim(x, _axis(axis_name), dim)
 
 
-def _sp_gather_fwd(x, axis_name):
-    return _gather_first(x, _axis(axis_name)), None
+def _sp_gather_fwd(x, axis_name, dim, tensor_parallel_output_grad):
+    return _gather_dim(x, _axis(axis_name), dim), None
 
 
-def _sp_gather_bwd(axis_name, _, g):
-    return (jax.lax.psum_scatter(g, _axis(axis_name), scatter_dimension=0, tiled=True),)
+def _sp_gather_bwd(axis_name, dim, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        return (
+            jax.lax.psum_scatter(
+                g, _axis(axis_name), scatter_dimension=dim % g.ndim,
+                tiled=True,
+            ),
+        )
+    return (_split_dim(g, _axis(axis_name), dim),)
 
 
 gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_scatter_to_sequence_parallel_region(x, axis_name=None):
-    return jax.lax.psum_scatter(x, _axis(axis_name), scatter_dimension=0, tiled=True)
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=None, dim=0):
+    return jax.lax.psum_scatter(
+        x, _axis(axis_name), scatter_dimension=dim % x.ndim, tiled=True
+    )
 
 
-def _sp_rs_fwd(x, axis_name):
+def _sp_rs_fwd(x, axis_name, dim):
     return (
-        jax.lax.psum_scatter(x, _axis(axis_name), scatter_dimension=0, tiled=True),
+        jax.lax.psum_scatter(
+            x, _axis(axis_name), scatter_dimension=dim % x.ndim, tiled=True
+        ),
         None,
     )
 
 
-def _sp_rs_bwd(axis_name, _, g):
-    return (_gather_first(g, _axis(axis_name)),)
+def _sp_rs_bwd(axis_name, dim, _, g):
+    return (_gather_dim(g, _axis(axis_name), dim),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

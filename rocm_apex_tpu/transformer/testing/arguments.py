@@ -405,7 +405,16 @@ def _add_training_args(p):
     g.add_argument("--no-async-tensor-model-parallel-allreduce",
                    action="store_false",
                    dest="async_tensor_model_parallel_allreduce",
-                   help="accepted for parity; XLA schedules collectives")
+                   help="disable the collective-matmul ring at the TP "
+                        "boundaries (ops/collective_matmul); the plain "
+                        "backward-psum overlap is XLA's either way — "
+                        "see docs/migration.md")
+    g.add_argument("--sequence-parallel", action="store_true",
+                   help="shard the activations between TP boundaries "
+                        "over the sequence (GPTConfig.sequence_parallel)")
+    g.add_argument("--collective-matmul", action="store_true",
+                   help="fuse the sequence-parallel boundary "
+                        "collectives into ppermute-ring matmuls")
 
 
 def _add_initialization_args(p):
