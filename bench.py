@@ -26,6 +26,10 @@ into ppermute-ring matmuls (ops/collective_matmul.py). These emit
 `_sp_tpN` / `_spcm_tpN`-suffixed metric keys so the tp-axis step-time
 series stays separate from the dp bench above.
 
+`--audit` (gpt bench) additionally prints a static program audit of
+one train step to stderr — collective counts/bytes + dot FLOPs from
+`rocm_apex_tpu.monitor.audit` (trace-only, no timing impact).
+
 Timing notes:
 * ITERS steps run inside ONE dispatch via `lax.scan` — the axon tunnel
   adds tens of ms of per-dispatch latency that real multi-step training
@@ -42,15 +46,16 @@ attn = fraction of bf16 peak FLOP/s, ln = xla_ms / pallas_ms
 (speedup), optim = bandwidth_floor_ms / measured_ms.
 """
 
-import json
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
+from rocm_apex_tpu import monitor
 from rocm_apex_tpu.amp import LossScaler
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
+from rocm_apex_tpu.monitor import peak_flops_per_chip  # noqa: F401 - re-export
 from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
 
 BATCH = 16
@@ -60,24 +65,6 @@ SEQ = 1024
 # N steps the wall clock over-reports each step by ~100/N ms — real
 # training fetches nothing per step.
 ITERS = 50
-
-
-def peak_flops_per_chip() -> float:
-    """Best-effort bf16 peak for the local chip; CPU fallback is nominal."""
-    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
-    table = {
-        "v6e": 918e12,
-        "v6": 918e12,
-        "v5p": 459e12,
-        "v5 lite": 197e12,
-        "v5e": 197e12,
-        "v5": 459e12,
-        "v4": 275e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    return 1e12
 
 
 def _dropout_rng0(dropout: float, on_tpu: bool):
@@ -90,19 +77,26 @@ def _dropout_rng0(dropout: float, on_tpu: bool):
     return jax.random.PRNGKey(2)
 
 
+# the driver's stdout contract rides the shared observability sink: one
+# MetricsLogger with a JsonlWriter on stdout, records passed through
+# verbatim (monitor/logger.py `emit`) so the BENCH_*.json comparisons
+# stay byte-for-byte valid
+_REPORT_LOGGER = monitor.MetricsLogger(
+    writers=[monitor.JsonlWriter(stream=sys.stdout)], memory_stats=False
+)
+
+
 def _report(metric, value, unit, vs_baseline, extra=""):
     print(extra, file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                # sub-10 values keep 4 decimals (a 0.168 ms kernel must
-                # not be published as 0.2)
-                "value": round(value, 1) if value >= 10 else round(value, 4),
-                "unit": unit,
-                "vs_baseline": round(vs_baseline, 4),
-            }
-        )
+    _REPORT_LOGGER.emit(
+        {
+            "metric": metric,
+            # sub-10 values keep 4 decimals (a 0.168 ms kernel must
+            # not be published as 0.2)
+            "value": round(value, 1) if value >= 10 else round(value, 4),
+            "unit": unit,
+            "vs_baseline": round(vs_baseline, 4),
+        }
     )
 
 
@@ -191,7 +185,8 @@ def bench_rn50(fused: bool = False):
     dt = (time.perf_counter() - t0) / iters
     img_s = batch / dt
     # RN50 train ~ 3 x 4.1 GFLOPs fwd per image at 224x224
-    mfu = (12.3e9 * batch / dt) / peak_flops_per_chip()
+    # (monitor.resnet50_train_flops — the shared accounting module)
+    mfu = monitor.mfu(monitor.resnet50_train_flops(batch), dt)
     # the driver's BASELINE series must never mix configs under one
     # key: the fused-kernel run gets its own metric name
     suffix = "_fused" if (fused and on_tpu) else ""
@@ -305,17 +300,16 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
     loss = float(losses[-1])
     dt = (time.perf_counter() - t0) / iters
     tok_s = batch * seq / dt
-    n_params = sum(
-        int(x.size) for x in jax.tree_util.tree_leaves(params32)
-    ) - cfg.vocab_size * cfg.hidden_size
-    # same Megatron-style crediting as the GPT bench: + the tied
-    # MLM-head projection trio (see main())
-    flops = (
-        6.0 * n_params * batch * seq
-        + 12.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
-        + 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size
+    # same Megatron-style crediting as the GPT bench, via the shared
+    # monitor.model_flops accounting (+ the tied MLM-head projection
+    # trio; see main())
+    flops = monitor.model_flops(
+        cfg, batch, seq,
+        raw_param_count=sum(
+            int(x.size) for x in jax.tree_util.tree_leaves(params32)
+        ),
     )
-    mfu = (flops / dt) / peak_flops_per_chip()
+    mfu = monitor.mfu(flops, dt)
     # non-default configs get distinct metric names: the driver's
     # BASELINE series must never mix configs under one key
     suffix = "_dropout" if dropout > 0.0 else ""
@@ -611,7 +605,8 @@ def bench_ln():
 
 def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
          remat: bool = False, loss: str = "fused",
-         seq_parallel: bool = False, collective_matmul: bool = False):
+         seq_parallel: bool = False, collective_matmul: bool = False,
+         audit: bool = False):
     if loss not in ("fused", "naive"):
         raise SystemExit(f"--loss must be 'fused' or 'naive', got {loss!r}")
     if collective_matmul and not seq_parallel:
@@ -746,6 +741,28 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
     else:
         runN = jax.jit(local_runN)
 
+    if audit:
+        # static program audit (monitor/audit.py): trace ONE train step
+        # abstractly — no compile, no timing impact — and report the
+        # collective counts/bytes and dot FLOPs to stderr. The jsonl
+        # stdout contract is untouched.
+        def _one(state, sstate, rng):
+            (_, _, _), scaled = one_step((state, sstate, rng), None)
+            return scaled
+
+        target = _one
+        if mesh is not None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            target = shard_map(
+                _one, mesh=mesh, in_specs=(P(), P(), P()),
+                out_specs=P(), check_rep=False,
+            )
+        report = monitor.audit(target, state, sstate, rng0)
+        print("audit: one gpt train step", file=sys.stderr)
+        print(report.summary(), file=sys.stderr)
+
     state, sstate, rng0, losses = runN(state, sstate, rng0)
     float(losses[-1])  # warmup + sync (value fetch, not block_until_ready)
 
@@ -778,24 +795,20 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         n_params = sum(
             int(x.size) for x in jax.tree_util.tree_leaves(count_tree)
         ) - cfg.vocab_size * cfg.hidden_size
-    # Model FLOPs, Megatron-style (Narayanan et al. 2021, the logit-
-    # layer term of their eq. 3; PaLM appendix B counts it the same
-    # way): 6·N over the non-embedding params, + the attention scores/
-    # context matmuls, + 6·B·s·h·V for the LM-head projection trio
-    # (fwd + dW + dx on the tied table — 17.3 ms/step of 94-98%-of-peak
-    # MXU work on this config, real dense math the round-3 formula
-    # credited at zero; BASELINE.md "MFU crediting" documents both
-    # numbers and the driver JSON carries the head-inclusive one).
-    model_flops = (
-        6.0 * n_params * batch * seq
-        + 12.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
-        + 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size
+    # Model FLOPs, Megatron-style, via the shared accounting module
+    # (monitor/flops.py — the one copy of the formula; its docstring
+    # carries the Narayanan/PaLM crediting discussion). The tied-head
+    # projection trio is real dense MXU work (17.3 ms/step of
+    # 94-98%-of-peak on this config); BASELINE.md "MFU crediting"
+    # documents both numbers and the driver JSON carries the
+    # head-inclusive one, with the sans-head figure on stderr.
+    step_flops = monitor.model_flops(cfg, batch, seq, n_params=n_params)
+    mfu = monitor.mfu(step_flops, dt, n_chips=tp)
+    mfu_sans_head = monitor.mfu(
+        monitor.model_flops(cfg, batch, seq, n_params=n_params,
+                            include_head=False),
+        dt, n_chips=tp,
     )
-    mfu = (model_flops / dt) / (peak_flops_per_chip() * tp)
-    mfu_sans_head = (
-        (model_flops - 6.0 * batch * seq * cfg.hidden_size * cfg.vocab_size)
-        / dt
-    ) / (peak_flops_per_chip() * tp)
     # per-chip normalization: the tp-sharded step spreads the same
     # global batch over tp chips
     tokens_per_sec = tokens_per_sec / tp
@@ -911,6 +924,8 @@ if __name__ == "__main__":
             kwargs["seq_parallel"] = True
         elif a == "--collective-matmul":
             kwargs["collective_matmul"] = True
+        elif a == "--audit":
+            kwargs["audit"] = True
         elif a.startswith("--loss="):
             kwargs["loss"] = a.split("=", 1)[1]
         elif a.startswith("--fused="):
@@ -933,6 +948,8 @@ if __name__ == "__main__":
         raise SystemExit("--seq applies to the gpt bench")
     if "loss" in kwargs and which != "gpt":
         raise SystemExit("--loss applies to the gpt bench")
+    if "audit" in kwargs and which != "gpt":
+        raise SystemExit("--audit applies to the gpt bench")
     if (
         "seq_parallel" in kwargs or "collective_matmul" in kwargs
     ) and which != "gpt":

@@ -17,7 +17,6 @@ CPU smoke (2-way TP x 4-way DP):
 """
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +28,13 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from rocm_apex_tpu.amp import all_finite
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
+from rocm_apex_tpu.monitor import (
+    JsonlWriter,
+    Metrics,
+    MetricsLogger,
+    model_flops,
+    tree_norm,
+)
 from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
 from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.transformer.amp import GradScaler
@@ -97,7 +103,24 @@ def main():
             state, grads,
             grad_scale=1.0 / scaler.loss_scale(sstate), skip=skip,
         )
-        return state2, sstate2, scaled / scaler.loss_scale(sstate)
+        inv_scale = 1.0 / scaler.loss_scale(sstate)
+        loss = scaled * inv_scale
+        # in-graph telemetry (monitor.Metrics): one pytree of fp32
+        # scalars riding the step outputs — the UNSCALED grad norm
+        # (grads here still carry the loss scale) over the rank-LOCAL
+        # trees (TP shards; identical across dp ranks after the pmean —
+        # a spike diagnostic rather than an exact global norm), plus
+        # the scaler's own observability counters
+        unscaled = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+        metrics = (
+            Metrics.empty()
+            .record("loss", loss)
+            .record_norm("grad_norm", unscaled)
+            .record_ratio_norms(unscaled, state.master, prefix="grad_ratio")
+            .record("loss_scale", sstate2.loss_scale)
+            .record("overflows", sstate2.overflows)
+        )
+        return state2, sstate2, metrics
 
     data_spec = P(parallel_state.DATA_AXIS)
     init_f = jax.jit(
@@ -120,23 +143,56 @@ def main():
     tokens0 = jnp.ones((b_local * dp, seq), jnp.int32)
     state, sstate = init_f(tokens0)
 
-    t0 = time.perf_counter()
+    # host-side pipeline (monitor.MetricsLogger): jsonl metric lines on
+    # stdout every log_interval steps — window means of the in-graph
+    # Metrics plus step time (Timers sync semantics: end_step fetches
+    # the loss), tokens/sec, and MFU from the shared model_flops
+    # accounting. Param count via eval_shape of an unsharded replica
+    # (abstract — no compute; local leaves are 1/tp shards under TP).
+    import dataclasses
+
+    cfg_count = dataclasses.replace(
+        cfg, tensor_parallel_size=1, sequence_parallel=False,
+        collective_matmul=False,
+    )
+    raw_count = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree_util.tree_leaves(
+            jax.eval_shape(
+                lambda t: GPTModel(cfg_count).init(
+                    jax.random.PRNGKey(0), t
+                ),
+                tokens0[:1],
+            )
+        )
+    )
+    logger = MetricsLogger(
+        writers=[JsonlWriter(stream=sys.stdout)],
+        window=args.log_interval,
+        tokens_per_step=b_local * dp * seq,
+        flops_per_step=model_flops(
+            cfg, b_local * dp, seq, raw_param_count=raw_count
+        ),
+        n_chips=tp * dp,
+    )
     for it in range(args.train_iters):
         rng, k = jax.random.split(rng)
         tokens = jax.random.randint(
             k, (b_local * dp, seq), 0, cfg.vocab_size
         )
         labels = jnp.roll(tokens, -1, axis=1)
-        state, sstate, loss = step_f(state, sstate, tokens, labels)
-        if (it + 1) % args.log_interval == 0:
-            lv = float(loss)  # value fetch = device sync
-            dt = (time.perf_counter() - t0) / args.log_interval
+        logger.start_step()
+        state, sstate, metrics = step_f(state, sstate, tokens, labels)
+        logger.end_step(sync_on=metrics["loss"])  # value fetch = sync
+        record = logger.log_step(it + 1, metrics)
+        if record is not None:
             print(
-                f"iter {it + 1}: lm loss {lv:.4f}  "
-                f"{b_local * dp * seq / dt:.0f} tokens/s  "
-                f"scale {float(sstate.loss_scale):.0f}"
+                f"iter {it + 1}: lm loss {record['loss']:.4f}  "
+                f"{record['tokens_per_sec']:.0f} tokens/s  "
+                f"grad_norm {record['grad_norm']:.3f}  "
+                f"scale {record['loss_scale']:.0f}",
+                file=sys.stderr,
             )
-            t0 = time.perf_counter()
 
 
 if __name__ == "__main__":

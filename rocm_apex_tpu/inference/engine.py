@@ -32,7 +32,8 @@ order regardless of wall-clock timing.
 
 import collections
 import dataclasses
-from typing import Any, List, Optional, Sequence
+import time
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -142,6 +143,18 @@ class InferenceEngine:
         self._next_id = 0
         self._prefill_traces = 0
         self._decode_traces = 0
+        # serving telemetry (read via `stats()`, fed to a
+        # monitor.MetricsLogger): monotonic counters + wall-time sums.
+        # Latencies include the result fetch — on the tunnel platform
+        # that fetch IS the device sync (the Timers rule), so these are
+        # true end-to-end numbers, not dispatch times.
+        self._admitted = 0
+        self._evicted = 0
+        self._prompt_tokens = 0
+        self._generated_tokens = 0
+        self._prefill_seconds = 0.0
+        self._decode_seconds = 0.0
+        self._decode_steps = 0
 
         sp = self.sampling
 
@@ -223,6 +236,47 @@ class InferenceEngine:
     def has_work(self) -> bool:
         return bool(self._queue) or self.num_active > 0
 
+    def stats(self) -> Dict[str, float]:
+        """Serving telemetry as one flat name→scalar dict — the
+        `monitor.MetricsLogger.log_step` input format (route the
+        monotonic counters through its ``last_value`` set).
+
+        Gauges: ``queue_depth``, ``slots_active``, ``slot_occupancy``.
+        Counters: ``admitted``, ``evicted``, ``prompt_tokens``,
+        ``generated_tokens``, ``decode_steps``. Derived: mean
+        prefill/decode latency (ms, sync-inclusive — see __init__) and
+        tokens/sec over each phase's accumulated wall time
+        (prefill = prompt tokens absorbed, decode = tokens emitted)."""
+        prefill_ms = (
+            1e3 * self._prefill_seconds / self._admitted
+            if self._admitted else 0.0
+        )
+        decode_ms = (
+            1e3 * self._decode_seconds / self._decode_steps
+            if self._decode_steps else 0.0
+        )
+        decode_generated = self._generated_tokens - self._admitted
+        return {
+            "queue_depth": float(self.num_queued),
+            "slots_active": float(self.num_active),
+            "slot_occupancy": self.num_active / self.num_slots,
+            "admitted": float(self._admitted),
+            "evicted": float(self._evicted),
+            "prompt_tokens": float(self._prompt_tokens),
+            "generated_tokens": float(self._generated_tokens),
+            "decode_steps": float(self._decode_steps),
+            "prefill_ms_avg": prefill_ms,
+            "decode_ms_avg": decode_ms,
+            "prefill_tokens_per_sec": (
+                self._prompt_tokens / self._prefill_seconds
+                if self._prefill_seconds > 0 else 0.0
+            ),
+            "decode_tokens_per_sec": (
+                decode_generated / self._decode_seconds
+                if self._decode_seconds > 0 else 0.0
+            ),
+        }
+
     def add_request(
         self,
         prompt: Sequence[int],
@@ -263,6 +317,7 @@ class InferenceEngine:
             toks = np.zeros((1, self.max_prompt_len), np.int32)
             toks[0, : len(req.prompt)] = req.prompt
             self._rng, rng = jax.random.split(self._rng)
+            t0 = time.perf_counter()
             with profiler.annotate(
                 "inference/prefill", slot=slot, prompt_len=len(req.prompt)
             ):
@@ -270,8 +325,13 @@ class InferenceEngine:
                     self.params, self.cache, jnp.asarray(toks),
                     slot, len(req.prompt), rng,
                 )
+            first_tok = int(tok)  # value fetch = device sync
+            self._prefill_seconds += time.perf_counter() - t0
+            self._admitted += 1
+            self._prompt_tokens += len(req.prompt)
+            self._generated_tokens += 1
             state = _Slot(
-                req=req, generated=[int(tok)], pos=len(req.prompt)
+                req=req, generated=[first_tok], pos=len(req.prompt)
             )
             done = self._finish_reason(state)
             if done is not None:
@@ -290,6 +350,7 @@ class InferenceEngine:
                 np.int32,
             )
             self._rng, rng = jax.random.split(self._rng)
+            t0 = time.perf_counter()
             with profiler.annotate(
                 "inference/decode", batch=int(active.sum())
             ):
@@ -297,7 +358,10 @@ class InferenceEngine:
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(active), rng,
                 )
-            toks = np.asarray(tok)
+            toks = np.asarray(tok)  # value fetch = device sync
+            self._decode_seconds += time.perf_counter() - t0
+            self._decode_steps += 1
+            self._generated_tokens += int(active.sum())
             for slot, state in enumerate(self._slots):
                 if state is None:
                     continue
@@ -344,6 +408,7 @@ class InferenceEngine:
         self, slot: int, state: _Slot, reason: str
     ) -> GenerationResult:
         self._slots[slot] = None
+        self._evicted += 1
         return GenerationResult(
             request_id=state.req.request_id,
             prompt=list(state.req.prompt),

@@ -133,6 +133,16 @@ class GPTConfig:
     # ring piece size in rows (None = one piece per shard; a chunk
     # that does not tile the shard falls back to the plain collective)
     collective_matmul_chunk: Optional[int] = None
+    # activation-RMS telemetry taps (rocm_apex_tpu.monitor): each layer
+    # sows the RMS of its attention and MLP outputs (and the model the
+    # final hidden state) into the "intermediates" collection as
+    # (sum_of_squares, count) pairs — psum'd over the tensor axis where
+    # the activation is a sequence shard, so the finalized RMS
+    # (monitor.activation_stats) is the GLOBAL statistic. Off by
+    # default: the sums are extra reductions on the hot path. Callers
+    # opt in per apply with mutable=["intermediates"]; without it the
+    # sows are flax no-ops.
+    activation_stats: bool = False
 
     def __post_init__(self):
         if self.sequence_parallel and self.context_parallel_axis is not None:
@@ -266,6 +276,25 @@ def _hidden_dropout_seed(mod: nn.Module, cfg: GPTConfig):
     if _sp_active(cfg, _resolve_tp(cfg)):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(cfg.tensor_axis))
     return jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
+
+
+def _sow_rms(mod: nn.Module, cfg: GPTConfig, name: str, x) -> None:
+    """Activation-RMS tap: sow (sum_of_squares, count) under
+    ``intermediates/<path>/<name>`` for `monitor.activation_stats` to
+    finalize into ``sqrt(sumsq/count)``.
+
+    Under sequence parallelism the tensor is a 1/tp sequence shard, so
+    the partial sums psum over the tensor axis — the PR-3 shard-partial
+    convention — and every rank sows the identical GLOBAL pair. A flax
+    no-op unless the caller passes mutable=["intermediates"]."""
+    if not cfg.activation_stats:
+        return
+    sumsq = jnp.sum(jnp.square(x.astype(jnp.float32)))
+    count = jnp.asarray(x.size, jnp.float32)
+    if _sp_active(cfg, _resolve_tp(cfg)):
+        sumsq = jax.lax.psum(sumsq, cfg.tensor_axis)
+        count = jax.lax.psum(count, cfg.tensor_axis)
+    mod.sow("intermediates", name, (sumsq, count))
 
 
 class ParallelMLP(nn.Module):
@@ -753,6 +782,7 @@ class ParallelTransformerLayer(nn.Module):
         new_kv = None
         if cache is not None:
             attn, new_kv = attn
+        _sow_rms(self, cfg, "attn_out", attn)
         if cfg.hidden_dropout > 0.0 and not ln_drop:
             attn = _hidden_dropout_mod(cfg)(
                 attn, deterministic=deterministic
@@ -778,6 +808,7 @@ class ParallelTransformerLayer(nn.Module):
             # standalone add is a pure HBM round trip otherwise)
             ln2, x = ln2_mod(attn.astype(x.dtype), residual=x)
         mlp = ParallelMLP(cfg, name="mlp")(ln2, deterministic)
+        _sow_rms(self, cfg, "mlp_out", mlp)
         if cfg.hidden_dropout > 0.0 and not (ln_drop and chain):
             # unchained exits add the delta eagerly (no LN kernel to
             # ride), so the MLP dropout stays standalone there
@@ -1043,6 +1074,7 @@ class GPTModel(nn.Module):
             return self.embedding.attend(x), cache
         x = self.embedding(tokens, position_ids, deterministic)
         x = self.transformer(x, deterministic=deterministic)
+        _sow_rms(self, self.cfg, "hidden_out", x)
         if _sp_active(self.cfg, _resolve_tp(self.cfg)):
             # sequence-parallel region exit: the LM head needs full
             # rows (the vocab is sharded over the SAME tensor axis, so

@@ -1,0 +1,63 @@
+"""`rocm_apex_tpu.monitor` — training/serving observability, three pillars.
+
+The reference scattered its telemetry (nvmarker payloads in pyprof,
+`_timers.py` synchronized timers, the amp scaler's overflow counter);
+this package is the shared layer the ROADMAP's production story needs:
+
+* **in-graph metrics** (`metrics.py`): the jit-safe `Metrics` pytree a
+  train step threads through and returns — grad norms, update ratios,
+  loss scale, activation RMS taps — zero extra traces, shard_map-
+  correct psums;
+* **host pipeline** (`logger.py`): `MetricsLogger` with windowed
+  aggregation, `Timers`-sync step timing, tokens/sec + MFU from the
+  shared `model_flops` accounting (`flops.py`), device-memory stats,
+  and pluggable writers (`JsonlWriter`, `TensorBoardWriter`);
+* **static auditor** (`audit.py`): walk a `ClosedJaxpr` and report
+  collective counts/bytes and dot FLOPs — the executable form of the
+  PR-3 "no gathered activation / ring collectives" invariants, and
+  bench.py's ``--audit`` report.
+
+See docs/observability.md for the full tour; `rocm_apex_tpu.profiler`
+remains the trace-capture layer (device timelines), while this package
+owns the per-step scalar stream and static program accounting.
+"""
+
+from rocm_apex_tpu.monitor.audit import (
+    AuditReport,
+    assert_no_intermediate,
+    audit,
+    audit_jaxpr,
+)
+from rocm_apex_tpu.monitor.flops import (
+    mfu,
+    model_flops,
+    peak_flops_per_chip,
+    resnet50_train_flops,
+    transformer_train_flops,
+)
+from rocm_apex_tpu.monitor.logger import (
+    JsonlWriter,
+    MetricsLogger,
+    TensorBoardWriter,
+    device_memory_stats,
+)
+from rocm_apex_tpu.monitor.metrics import Metrics, activation_stats, tree_norm
+
+__all__ = [
+    "Metrics",
+    "tree_norm",
+    "activation_stats",
+    "MetricsLogger",
+    "JsonlWriter",
+    "TensorBoardWriter",
+    "device_memory_stats",
+    "model_flops",
+    "transformer_train_flops",
+    "resnet50_train_flops",
+    "peak_flops_per_chip",
+    "mfu",
+    "AuditReport",
+    "audit",
+    "audit_jaxpr",
+    "assert_no_intermediate",
+]

@@ -1,0 +1,280 @@
+"""Static comms/FLOPs auditor: walk a jaxpr, report what a step MOVES.
+
+The PR-3 collective-matmul work (arXiv 2305.06942) is only verifiable
+by looking at the traced program: did the blocking `all_gather` really
+become a `ppermute` ring, does a full-sequence activation still hide
+between the sequence-parallel regions, how many bytes does one train
+step put on the ICI? Until now those questions lived as ad-hoc
+``"2,32,64]" in str(jax.make_jaxpr(...))`` greps scattered through
+tests/L0. This module owns them:
+
+    report = audit(step_fn, *example_args)     # jax.make_jaxpr, no compile
+    report.count("ppermute")                   # collective counts
+    report.bytes("all_gather")                 # payload bytes moved
+    report.dot_flops                           # total dot_general FLOPs
+    report.has_intermediate((2, 32, 64))       # shape-existence probe
+    print(report.summary())
+
+The walk recurses into every subjaxpr — pjit, `lax.scan` (inner counts
+multiply by the trip count), cond (branches merge by MAX: one branch
+executes), while (body counted once, flagged as a lower bound),
+custom_jvp/custom_vjp, remat, shard_map — so counts reflect the whole
+program, not its top level.
+
+Accounting conventions (kept deliberately simple and documented, not
+clever):
+
+* **counts** are primitive-execution counts after trip-count
+  multiplication. `lax.psum_scatter` traces as the ``reduce_scatter``
+  primitive; `count()` accepts either name.
+* **bytes** per collective = the payload (sum of output-aval bytes),
+  NOT wire bytes — ring/algorithm factors (the 2(n−1)/n of an
+  all-reduce) depend on the implementation the compiler picks and are
+  not knowable from the jaxpr.
+* **dot_flops** = 2·|out|·k per `dot_general` (MAC-counting, the
+  profiler's convention), trip-count multiplied.
+* **shapes** is the set of every intermediate (equation-output) aval
+  shape anywhere in the program — inputs and constants are NOT
+  intermediates, so a probe for a forbidden materialization cannot be
+  fooled by the operand that legitimately enters at a region boundary.
+"""
+
+import dataclasses
+from typing import Any, Dict, FrozenSet, Optional, Tuple
+
+import jax
+import numpy as np
+from jax import core as jax_core
+
+__all__ = ["AuditReport", "audit", "audit_jaxpr", "assert_no_intermediate"]
+
+# collective primitives worth counting/sizing (cross-device traffic)
+_COLLECTIVES = {
+    "psum",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute",
+    "all_to_all",
+    "pgather",
+}
+# user-facing aliases -> primitive names
+_ALIASES = {"psum_scatter": "reduce_scatter", "collective_permute": "ppermute"}
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditReport:
+    """What one traced program moves and multiplies.
+
+    ``counts``/``bytes_moved`` key on primitive names (`_ALIASES`
+    accepted through the accessors); ``shapes`` holds every
+    intermediate aval shape. ``while_lower_bound`` marks that a
+    `lax.while_loop` body was counted once — totals are then lower
+    bounds, not exact."""
+
+    counts: Dict[str, float]
+    bytes_moved: Dict[str, float]
+    dot_flops: float
+    dot_count: float
+    shapes: FrozenSet[Tuple[int, ...]]
+    while_lower_bound: bool = False
+
+    # -- accessors ------------------------------------------------------
+
+    def count(self, name: str) -> int:
+        name = _ALIASES.get(name, name)
+        return int(self.counts.get(name, 0))
+
+    def bytes(self, name: str) -> float:
+        name = _ALIASES.get(name, name)
+        return float(self.bytes_moved.get(name, 0.0))
+
+    @property
+    def collective_count(self) -> int:
+        return int(sum(self.counts.values()))
+
+    @property
+    def collective_bytes(self) -> float:
+        return float(sum(self.bytes_moved.values()))
+
+    def has_intermediate(self, shape) -> bool:
+        """True iff some equation anywhere in the program OUTPUTS an
+        array of exactly this shape."""
+        return tuple(shape) in self.shapes
+
+    def intermediates_matching(self, shape):
+        """All intermediate shapes equal to ``shape`` up to leading
+        batch dims (diagnostic helper)."""
+        shape = tuple(shape)
+        return sorted(
+            s for s in self.shapes if s[-len(shape):] == shape and shape
+        )
+
+    def summary(self) -> str:
+        """Human-readable table (the bench --audit report body)."""
+        lines = ["collective            count        MB payload"]
+        for name in sorted(self.counts):
+            lines.append(
+                f"{name:<20} {int(self.counts[name]):>6} "
+                f"{self.bytes_moved.get(name, 0.0) / 1e6:>13.3f}"
+            )
+        if not self.counts:
+            lines.append("(none)")
+        lines.append(
+            f"dot_general: {int(self.dot_count)} ops, "
+            f"{self.dot_flops / 1e9:.3f} GFLOP"
+            + (" (while-loop: lower bounds)" if self.while_lower_bound
+               else "")
+        )
+        return "\n".join(lines)
+
+
+def _aval_bytes(aval) -> float:
+    try:
+        return float(np.prod(aval.shape, dtype=np.float64)) * np.dtype(
+            aval.dtype
+        ).itemsize
+    except Exception:  # noqa: BLE001 - abstract token/opaque avals
+        return 0.0
+
+
+def _merge(dst: Dict[str, float], src: Dict[str, float], scale: float):
+    for k, v in src.items():
+        dst[k] = dst.get(k, 0.0) + v * scale
+
+
+def _merge_max(dst: Dict[str, float], src: Dict[str, float]):
+    for k, v in src.items():
+        dst[k] = max(dst.get(k, 0.0), v)
+
+
+def _inner_jaxprs(params):
+    """Every (Closed)Jaxpr hiding in an equation's params."""
+    for v in params.values():
+        if isinstance(v, (jax_core.Jaxpr, jax_core.ClosedJaxpr)):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                if isinstance(item, (jax_core.Jaxpr, jax_core.ClosedJaxpr)):
+                    yield item
+
+
+def _walk(jaxpr) -> AuditReport:
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    counts: Dict[str, float] = {}
+    nbytes: Dict[str, float] = {}
+    dot_flops = 0.0
+    dot_count = 0.0
+    shapes = set()
+    lower_bound = False
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        for ov in eqn.outvars:
+            aval = getattr(ov, "aval", None)
+            if aval is not None and getattr(aval, "shape", None) is not None:
+                shapes.add(tuple(aval.shape))
+
+        if name in _COLLECTIVES:
+            counts[name] = counts.get(name, 0.0) + 1.0
+            nbytes[name] = nbytes.get(name, 0.0) + sum(
+                _aval_bytes(ov.aval) for ov in eqn.outvars
+            )
+            continue
+        if name == "dot_general":
+            (lc, _), _ = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval
+            k = float(np.prod([lhs.shape[d] for d in lc], dtype=np.float64))
+            out_n = float(
+                np.prod(eqn.outvars[0].aval.shape, dtype=np.float64)
+            )
+            dot_flops += 2.0 * out_n * max(k, 1.0)
+            dot_count += 1.0
+            continue
+
+        inner = list(_inner_jaxprs(eqn.params))
+        if not inner:
+            continue
+        if name == "cond":
+            # one branch executes: merge branch audits by max
+            b_counts: Dict[str, float] = {}
+            b_bytes: Dict[str, float] = {}
+            b_flops = b_dots = 0.0
+            for br in inner:
+                r = _walk(br)
+                _merge_max(b_counts, r.counts)
+                _merge_max(b_bytes, r.bytes_moved)
+                b_flops = max(b_flops, r.dot_flops)
+                b_dots = max(b_dots, r.dot_count)
+                shapes |= r.shapes
+                lower_bound |= r.while_lower_bound
+            _merge(counts, b_counts, 1.0)
+            _merge(nbytes, b_bytes, 1.0)
+            dot_flops += b_flops
+            dot_count += b_dots
+            continue
+        scale = 1.0
+        if name == "scan":
+            scale = float(eqn.params.get("length", 1))
+        elif name == "while":
+            # trip count is dynamic: count the body once, flag totals
+            lower_bound = True
+        for sub in inner:
+            r = _walk(sub)
+            _merge(counts, r.counts, scale)
+            _merge(nbytes, r.bytes_moved, scale)
+            dot_flops += r.dot_flops * scale
+            dot_count += r.dot_count * scale
+            shapes |= r.shapes
+            lower_bound |= r.while_lower_bound
+
+    return AuditReport(
+        counts=counts,
+        bytes_moved=nbytes,
+        dot_flops=dot_flops,
+        dot_count=dot_count,
+        shapes=frozenset(shapes),
+        while_lower_bound=lower_bound,
+    )
+
+
+def audit_jaxpr(closed_jaxpr) -> AuditReport:
+    """Audit an already-traced `ClosedJaxpr` (or raw `Jaxpr`)."""
+    return _walk(closed_jaxpr)
+
+
+def audit(fn, *args, **kwargs) -> AuditReport:
+    """Trace ``fn(*args, **kwargs)`` with `jax.make_jaxpr` (abstract —
+    nothing compiles or runs) and audit the result. ``fn`` must be the
+    COMPLETE unit of interest: to audit a shard_map'd step, pass the
+    wrapped function, not the body."""
+    return _walk(jax.make_jaxpr(fn, **{})(*args, **kwargs))
+
+
+def assert_no_intermediate(
+    target, shape, *args, msg: Optional[str] = None
+) -> AuditReport:
+    """Assert no equation in the program outputs an array of ``shape``.
+
+    ``target`` is a `ClosedJaxpr`/`AuditReport`, or a callable (then
+    ``*args`` are its example arguments). Returns the report so
+    callers can chain count assertions. The executable form of the
+    PR-3 acceptance bar: no full ``(b, s, h)`` gathered activation
+    between sequence-parallel regions."""
+    if isinstance(target, AuditReport):
+        report = target
+    elif callable(target) and not isinstance(
+        target, (jax_core.Jaxpr, jax_core.ClosedJaxpr)
+    ):
+        report = audit(target, *args)
+    else:
+        report = audit_jaxpr(target)
+    if report.has_intermediate(shape):
+        raise AssertionError(
+            msg
+            or f"forbidden intermediate of shape {tuple(shape)} found "
+            "in the traced program"
+        )
+    return report

@@ -1,0 +1,242 @@
+"""Host-side metrics pipeline: windowed aggregation + pluggable writers.
+
+The consumer of the in-graph `Metrics` pytree (monitor/metrics.py) and
+of any plain name→scalar dict (the inference engine's ``stats()``, the
+bench driver's report rows). One `MetricsLogger` owns:
+
+* **step timing** with the `Timers` sync semantics (_timers.py): on
+  the tunnel platform ``block_until_ready`` does not synchronize, so
+  ``end_step(sync_on=loss)`` ends the timed region with a value fetch
+  — the same rule bench.py documents;
+* **windowed aggregation**: scalars accumulate for ``window`` steps
+  and flush as means (counters flush as last-value — pass their names
+  in ``last_value``), so the device→host fetch and the write happen
+  once per window, not once per step;
+* **derived throughput**: tokens/sec from ``tokens_per_step`` and MFU
+  from ``flops_per_step`` (use `monitor.model_flops`) over the peak of
+  ``n_chips`` chips — the formulas bench.py used to hand-roll thrice;
+* **device-memory stats**: bytes-in-use / peak from
+  ``Device.memory_stats()`` where the backend provides them;
+* **pluggable writers**: anything with ``write(step, scalars)``.
+  `JsonlWriter` emits one JSON object per line (the bench driver's
+  stdout contract); `TensorBoardWriter` adapts any
+  ``add_scalar(tag, value, step)`` object — the same interface
+  `Timers.write` targets, so timers and metrics can share one sink.
+"""
+
+import json
+import sys
+from typing import Any, Dict, Iterable, Optional, Sequence
+
+from rocm_apex_tpu.monitor.flops import mfu as _mfu
+from rocm_apex_tpu.monitor.flops import peak_flops_per_chip
+from rocm_apex_tpu.transformer._timers import Timers
+
+__all__ = [
+    "JsonlWriter",
+    "TensorBoardWriter",
+    "MetricsLogger",
+    "device_memory_stats",
+]
+
+
+def device_memory_stats(device=None) -> Dict[str, float]:
+    """{'mem_bytes_in_use': ..., 'mem_peak_bytes_in_use': ...} for one
+    device; empty where the backend has no allocator stats (CPU)."""
+    if device is None:
+        import jax
+
+        device = jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 - backend without allocator stats
+        stats = None
+    if not stats:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use"):
+        if key in stats:
+            out[f"mem_{key}"] = float(stats[key])
+    return out
+
+
+class JsonlWriter:
+    """One JSON object per line, keys in insertion order.
+
+    The bench driver's stdout contract (`bench._report`) routes through
+    `emit`; the logger's windowed flushes route through `write`. Also
+    exposes ``add_scalar`` so a `Timers.write(names, writer, it)` call
+    can land timer rows in the same stream."""
+
+    def __init__(self, stream=None, path: Optional[str] = None):
+        if (stream is None) == (path is None):
+            raise ValueError("pass exactly one of stream or path")
+        self._own = path is not None
+        self._stream = open(path, "a") if path else stream
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        print(json.dumps(record), file=self._stream, flush=True)
+
+    def write(self, step: int, scalars: Dict[str, Any]) -> None:
+        self.emit({"step": int(step), **scalars})
+
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        """`Timers.write`-compatible single-scalar entry point."""
+        self.emit({"step": int(step), tag: float(value)})
+
+    def close(self) -> None:
+        if self._own:
+            self._stream.close()
+
+
+class TensorBoardWriter:
+    """Adapter from the writer protocol to any object exposing
+    ``add_scalar(tag, value, step)`` (a real
+    ``tensorboardX``/``tf.summary`` writer, or `JsonlWriter` itself —
+    the interface `Timers.write` already targets; no TensorBoard
+    dependency is imported here)."""
+
+    def __init__(self, summary_writer):
+        self._w = summary_writer
+
+    def write(self, step: int, scalars: Dict[str, Any]) -> None:
+        for tag, value in scalars.items():
+            self._w.add_scalar(tag, float(value), int(step))
+
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        self._w.add_scalar(tag, float(value), int(step))
+
+
+class MetricsLogger:
+    """Windowed host-side aggregator over per-step scalar dicts.
+
+    Typical wiring (examples/gpt_train.py)::
+
+        logger = MetricsLogger(
+            writers=[JsonlWriter(stream=sys.stdout)],
+            window=args.log_interval,
+            tokens_per_step=global_batch * seq,
+            flops_per_step=model_flops(cfg, global_batch, seq,
+                                       raw_param_count=n),
+            n_chips=tp * dp,
+        )
+        for it in range(iters):
+            logger.start_step()
+            state, sstate, metrics = step_f(state, sstate, batch)
+            logger.end_step(sync_on=metrics["loss"])
+            logger.log_step(it, metrics)   # flushes every `window`
+
+    ``log_step`` accepts a `Metrics`, a name→scalar dict, or anything
+    with ``as_dict()`` (device scalars are fetched via ``float`` only
+    at flush time). Names listed in ``last_value`` flush as their last
+    value instead of the window mean (monotonic counters: the scaler's
+    ``overflows``, the engine's admit/evict totals).
+    """
+
+    def __init__(
+        self,
+        writers: Sequence[Any] = (),
+        *,
+        window: int = 1,
+        tokens_per_step: Optional[float] = None,
+        flops_per_step: Optional[float] = None,
+        n_chips: int = 1,
+        peak_flops: Optional[float] = None,
+        last_value: Iterable[str] = ("overflows",),
+        timers: Optional[Timers] = None,
+        memory_stats: bool = True,
+    ):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.writers = list(writers) or [JsonlWriter(stream=sys.stdout)]
+        self.window = window
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_step = flops_per_step
+        self.n_chips = n_chips
+        self._peak = peak_flops
+        self._last_value = set(last_value)
+        self.timers = timers if timers is not None else Timers()
+        self._memory_stats = memory_stats
+        self._acc: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+        self._count = 0
+        self._step_seconds = 0.0
+        self._timed_steps = 0
+
+    # -- step timing (Timers sync semantics) ---------------------------
+
+    def start_step(self) -> None:
+        self.timers("step").start()
+
+    def end_step(self, sync_on=None) -> None:
+        """Stop the step timer; ``sync_on`` is fetched first (a true
+        device sync — `_timers._Timer.stop`)."""
+        t = self.timers("step")
+        t.stop(sync_on=sync_on)
+        self._step_seconds += t.elapsed(reset=True)
+        self._timed_steps += 1
+
+    # -- logging --------------------------------------------------------
+
+    def log_step(self, step: int, scalars, **extra) -> Optional[Dict]:
+        """Accumulate one step's scalars; flush when the window fills.
+        Returns the flushed record (also handed to every writer) or
+        None mid-window."""
+        if hasattr(scalars, "as_dict"):
+            scalars = scalars.as_dict()
+        scalars = {**scalars, **extra}
+        for name, value in scalars.items():
+            value = float(value)
+            self._last[name] = value
+            self._acc[name] = self._acc.get(name, 0.0) + value
+        self._count += 1
+        if self._count < self.window:
+            return None
+        return self.flush(step)
+
+    def flush(self, step: int) -> Optional[Dict]:
+        """Aggregate the open window and write it out."""
+        if self._count == 0:
+            return None
+        record: Dict[str, float] = {}
+        for name in self._acc:
+            record[name] = (
+                self._last[name]
+                if name.split("/")[-1] in self._last_value
+                else self._acc[name] / self._count
+            )
+        if self._timed_steps:
+            dt = self._step_seconds / self._timed_steps
+            record["step_time_ms"] = dt * 1000.0
+            if self.tokens_per_step:
+                record["tokens_per_sec"] = self.tokens_per_step / dt
+            if self.flops_per_step:
+                if self._peak is None:
+                    self._peak = peak_flops_per_chip()
+                record["mfu"] = _mfu(
+                    self.flops_per_step, dt,
+                    n_chips=self.n_chips, peak=self._peak,
+                )
+        if self._memory_stats:
+            record.update(device_memory_stats())
+        for w in self.writers:
+            w.write(step, record)
+        self._acc.clear()
+        self._last.clear()
+        self._count = 0
+        self._step_seconds = 0.0
+        self._timed_steps = 0
+        return record
+
+    # -- raw passthrough (the bench driver's stdout contract) -----------
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        """Hand a fully-formed record to every writer that can take one
+        verbatim (`JsonlWriter.emit`); writers without ``emit`` get it
+        as step -1 scalars."""
+        for w in self.writers:
+            if hasattr(w, "emit"):
+                w.emit(record)
+            else:
+                w.write(-1, {k: v for k, v in record.items()
+                             if isinstance(v, (int, float))})

@@ -1,0 +1,226 @@
+"""In-graph training telemetry: a jit-safe, functional `Metrics` pytree.
+
+The reference treats telemetry as a first-class layer (nvmarker trace
+payloads, `_timers.py` synchronized timers, the scaler's overflow
+counter) but every number it reports is a host-side read of mutable
+state. Under jit that model breaks: a train step is ONE compiled
+program, and anything observed from inside it must ride the program's
+outputs. `Metrics` is that ride — a flat name→fp32-scalar pytree that a
+train step threads through and returns next to the loss:
+
+    def step(state, tokens):
+        metrics = Metrics.empty()
+        loss, grads = jax.value_and_grad(loss_fn)(state.model)
+        metrics = metrics.record("loss", loss)
+        metrics = metrics.record_norm("grad_norm", grads)
+        metrics = metrics.record_ratio_norms(updates, params)
+        ...
+        return new_state, metrics
+
+Design rules (all enforced by tests/L0/test_monitor.py):
+
+* **functional**: every `record` returns a NEW Metrics; nothing mutates.
+  The set of names is fixed at trace time (the step records the same
+  names every call), so the pytree structure is static and the step
+  compiles exactly once — metrics add ZERO trace count.
+* **scalars only**: each entry is one fp32 scalar. Anything bigger
+  belongs in a profiler capture, not the per-step stream.
+* **shard_map-correct**: a metric computed from shard-local data is
+  PARTIAL and must be reduced over the mesh axis before it means
+  anything — the same convention as the PR-3 gradients (grads taken
+  inside shard_map, psum'd where shard-partial). `record(...,
+  axis_name=...)` psums the value; `record_norm(..., axis_name=...)`
+  psums the sum of SQUARES (the correct reduction for an L2 norm over
+  disjoint shards) before the sqrt. Replicated values take no axis.
+
+Host side, `MetricsLogger` (monitor/logger.py) consumes
+`metrics.as_dict()` — one device→host fetch per logging window, never
+per step.
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Metrics", "tree_norm", "activation_stats"]
+
+
+def _psum(value, axis_name):
+    return jax.lax.psum(value, axis_name) if axis_name else value
+
+
+def tree_norm(tree: Any, axis_name: Optional[str] = None) -> jnp.ndarray:
+    """Global L2 norm of a pytree, in fp32.
+
+    With ``axis_name``, the tree's leaves are treated as disjoint
+    shards over that mesh axis (a TP-sharded grad tree inside
+    shard_map): the per-shard sum of squares is psum'd BEFORE the
+    sqrt — ``sqrt(psum(sum(g**2)))``, the norm of the full tree.
+    Replicated trees must not pass an axis (they would be counted
+    axis-size times)."""
+    leaves = [
+        x
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sumsq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(_psum(sumsq, axis_name))
+
+
+@jax.tree_util.register_pytree_node_class
+class Metrics:
+    """Immutable name→scalar mapping, registered as a pytree.
+
+    Flattens to its values sorted by name (the names are the static
+    treedef), so it jits, scans, and shard_maps like any other carry
+    leaf group."""
+
+    __slots__ = ("_scalars",)
+
+    def __init__(self, scalars: Optional[Dict[str, Any]] = None):
+        self._scalars = dict(scalars or {})
+
+    # -- pytree protocol ------------------------------------------------
+
+    def tree_flatten(self):
+        names = tuple(sorted(self._scalars))
+        return tuple(self._scalars[n] for n in names), names
+
+    @classmethod
+    def tree_unflatten(cls, names, values):
+        return cls(dict(zip(names, values)))
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def empty(cls) -> "Metrics":
+        return cls({})
+
+    def record(
+        self, name: str, value, axis_name: Optional[str] = None
+    ) -> "Metrics":
+        """New Metrics with ``name`` set to fp32 ``value``.
+
+        ``axis_name``: the value is a shard-PARTIAL sum (e.g. a loss
+        term summed over local rows under sequence parallelism) and is
+        psum'd over the axis. Replicated values take no axis."""
+        value = _psum(jnp.asarray(value, jnp.float32), axis_name)
+        if value.ndim != 0:
+            raise ValueError(
+                f"metric {name!r} must be a scalar, got shape "
+                f"{value.shape} — per-tensor stats belong in a "
+                "profiler capture, not the per-step metric stream"
+            )
+        new = dict(self._scalars)
+        new[name] = value
+        return Metrics(new)
+
+    def record_norm(
+        self, name: str, tree: Any, axis_name: Optional[str] = None
+    ) -> "Metrics":
+        """Global L2 norm of a pytree (see `tree_norm` for the
+        shard_map psum convention)."""
+        return self.record(name, tree_norm(tree, axis_name))
+
+    def record_ratio_norms(
+        self,
+        updates: Any,
+        params: Any,
+        prefix: str = "ratio",
+        axis_name: Optional[str] = None,
+    ) -> "Metrics":
+        """Per-top-level-group ‖update‖/‖param‖ ratios.
+
+        The LARC/LAMB-style trust diagnostic, per parameter GROUP (the
+        top level of the tree: embedding / transformer / ...): a group
+        whose ratio runs hot is diverging long before the loss shows
+        it. Both trees must share structure; grads from inside
+        shard_map follow the same psum'd-sum-of-squares rule."""
+        out = self
+        u_top = _top_level_groups(updates)
+        p_top = _top_level_groups(params)
+        for key in sorted(u_top):
+            ratio = tree_norm(u_top[key], axis_name) / jnp.maximum(
+                tree_norm(p_top[key], axis_name), 1e-12
+            )
+            out = out.record(f"{prefix}/{key}", ratio)
+        return out
+
+    def merge(self, other: "Metrics") -> "Metrics":
+        """Union of two Metrics; ``other`` wins on name collisions."""
+        new = dict(self._scalars)
+        new.update(other._scalars)
+        return Metrics(new)
+
+    # -- access ---------------------------------------------------------
+
+    def names(self):
+        return sorted(self._scalars)
+
+    def as_dict(self) -> Dict[str, Any]:
+        """name → scalar (still device arrays inside jit; host floats
+        after the step returns). The MetricsLogger input format."""
+        return dict(self._scalars)
+
+    def __getitem__(self, name: str):
+        return self._scalars[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._scalars
+
+    def __len__(self) -> int:
+        return len(self._scalars)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}" for n in self.names())
+        return f"Metrics({inner})"
+
+
+def _top_level_groups(tree: Any) -> Dict[str, Any]:
+    """{'embedding': subtree, ...} for the first mapping level of a
+    (possibly flax-style {'params': {...}}) tree; non-mapping trees
+    fall into one group 'all'."""
+    if hasattr(tree, "items"):
+        items = dict(tree)
+        if set(items) == {"params"}:
+            items = dict(items["params"])
+        return items
+    return {"all": tree}
+
+
+def activation_stats(
+    intermediates: Any, prefix: str = "act_rms"
+) -> Dict[str, jnp.ndarray]:
+    """Flatten flax ``intermediates`` sown by the GPT activation taps
+    into ``{"act_rms/<module/path>": rms}`` scalars.
+
+    The taps (`GPTConfig.activation_stats`) sow ``(sum_of_squares,
+    count)`` pairs — already psum'd over the tensor axis where the
+    activation is a sequence shard — so the finalization here is just
+    ``sqrt(sumsq / count)``. Feed the result to `Metrics.merge` via
+    ``Metrics(activation_stats(inters))`` or record the entries
+    individually."""
+    out: Dict[str, jnp.ndarray] = {}
+    flat = jax.tree_util.tree_flatten_with_path(
+        intermediates, is_leaf=lambda x: isinstance(x, tuple)
+    )[0]
+    for path, leaf in flat:
+        # flax sow wraps each tap in a tuple of appended values
+        while isinstance(leaf, tuple) and len(leaf) == 1:
+            leaf = leaf[0]
+        if not (isinstance(leaf, tuple) and len(leaf) == 2):
+            continue
+        sumsq, count = leaf
+        parts = [
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        ]
+        # drop the collection name and the tap's own key from the path
+        parts = [p for p in parts if p not in ("intermediates", prefix)]
+        out[f"{prefix}/" + "/".join(parts)] = jnp.sqrt(
+            sumsq.astype(jnp.float32)
+            / jnp.maximum(count.astype(jnp.float32), 1.0)
+        )
+    return out

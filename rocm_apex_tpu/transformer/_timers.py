@@ -39,10 +39,10 @@ class _Timer:
         self.elapsed_ = 0.0
         self.started_ = False
 
-    def elapsed(self, reset: bool = True) -> float:
+    def elapsed(self, reset: bool = True, sync_on=None) -> float:
         was_started = self.started_
         if was_started:
-            self.stop()
+            self.stop(sync_on=sync_on)
         out = self.elapsed_
         if reset:
             self.reset()
@@ -52,7 +52,17 @@ class _Timer:
 
 
 class Timers:
-    """Registry (reference _timers.py Timers.__call__/log)."""
+    """Registry (reference _timers.py Timers.__call__/log).
+
+    Both sinks — `log` (stdout) and `write` (TensorBoard-style
+    ``add_scalar``) — RESET the timers they report by default. The
+    reference shipped an asymmetry (log reset=True, write reset=False)
+    that double-counted every window in TensorBoard while stdout showed
+    per-window numbers; one default means the two sinks can never
+    disagree about what a value covers. Pass ``reset=False`` explicitly
+    for cumulative reporting. ``sync_on`` on either sink gives a timer
+    that is STILL RUNNING the true-device-sync stop treatment (a value
+    fetch — `_Timer.stop`) before it is read."""
 
     def __init__(self):
         self.timers = {}
@@ -68,19 +78,31 @@ class Timers:
         normalizer: float = 1.0,
         reset: bool = True,
         printer=print,
+        sync_on=None,
     ):
         assert normalizer > 0.0
         parts = ["time (ms)"]
         for name in names:
             if name in self.timers:
-                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                ms = (
+                    self.timers[name].elapsed(reset=reset, sync_on=sync_on)
+                    * 1000.0
+                    / normalizer
+                )
                 parts.append(f"{name}: {ms:.2f}")
         printer(" | ".join(parts))
 
-    def write(self, names, writer, iteration, normalizer=1.0, reset=False):
-        """Tensorboard-style hook (reference _timers.py write)."""
+    def write(
+        self, names, writer, iteration, normalizer=1.0, reset=True,
+        sync_on=None,
+    ):
+        """Tensorboard-style hook (reference _timers.py write), with
+        `log`'s defaults and sync semantics (see class docstring)."""
         assert normalizer > 0.0
         for name in names:
             if name in self.timers:
-                value = self.timers[name].elapsed(reset=reset) / normalizer
+                value = (
+                    self.timers[name].elapsed(reset=reset, sync_on=sync_on)
+                    / normalizer
+                )
                 writer.add_scalar(f"{name}-time", value, iteration)

@@ -10,16 +10,35 @@ import os
 __all__ = ["get_transformer_logger", "set_logging_level"]
 
 _ENV = "APEX_TPU_LOG_LEVEL"
+_ROOT = "rocm_apex_tpu.transformer"
+# read once at import: the env var is a process-level setting, and the
+# previous per-call read meant a logger could flip level mid-run when
+# the environment mutated (and paid a getenv on every getLogger)
+_ENV_LEVEL = os.environ.get(_ENV)
 
 
 def get_transformer_logger(name: str) -> logging.Logger:
-    name = name.rsplit(".", 1)[-1]
-    logger = logging.getLogger(f"rocm_apex_tpu.transformer.{name}")
-    level = os.environ.get(_ENV)
-    if level:
-        logger.setLevel(level.upper())
+    """Logger for ``name`` (pass ``__name__``) nested under the
+    ``rocm_apex_tpu.transformer`` root.
+
+    The FULL dotted path is kept: the old ``rsplit('.', 1)[-1]``
+    basename collapsed distinct modules with the same final component
+    (any two ``utils`` modules shared one logger, so a level set for
+    one silenced the other). Package-internal names drop the redundant
+    ``rocm_apex_tpu.``/``rocm_apex_tpu.transformer.`` prefix; anything
+    else nests verbatim — distinct modules always get distinct loggers,
+    and `set_logging_level` on the root still reaches all of them."""
+    if name == _ROOT:
+        name = ""
+    for prefix in (_ROOT + ".", "rocm_apex_tpu."):
+        if name.startswith(prefix):
+            name = name[len(prefix):]
+            break
+    logger = logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+    if _ENV_LEVEL:
+        logger.setLevel(_ENV_LEVEL.upper())
     return logger
 
 
 def set_logging_level(verbosity) -> None:
-    logging.getLogger("rocm_apex_tpu.transformer").setLevel(verbosity)
+    logging.getLogger(_ROOT).setLevel(verbosity)

@@ -69,9 +69,38 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import core as _jax_core
 
 from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.utils.compat import axis_size, pcast_varying
+
+
+def _start_timer(timers, forward_only):
+    """Observability hook (rocm_apex_tpu.monitor): every schedule takes
+    ``timers=`` (a `transformer._timers.Timers`) and times the whole
+    schedule call under ``pipeline/forward`` / ``pipeline/fwd-bwd``.
+    Called eagerly the stop syncs on the losses (a value fetch — true
+    device wall time); called under jit the outputs are tracers, so the
+    stop records trace/build time only and the in-graph phase
+    attribution comes from the ``pp_fwd``/``pp_bwd``/``pp_comm``/
+    ``pp_head`` named scopes instead (visible to `profiler.op_stats` —
+    one fused scan admits no host-side phase timers)."""
+    if timers is None:
+        return None
+    t = timers("pipeline/forward" if forward_only else "pipeline/fwd-bwd")
+    t.start()
+    return t
+
+
+def _finish_timer(t, out):
+    if t is None:
+        return out
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if x is not None]
+    sync = None
+    if leaves and not any(isinstance(x, _jax_core.Tracer) for x in leaves):
+        sync = leaves[0]
+    t.stop(sync_on=sync)
+    return out
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -214,6 +243,7 @@ def forward_backward_no_pipelining(
     axis_name: Optional[str] = None,
     extra_params: Any = None,
     pre_fn=None,
+    timers=None,
     **unused_kw,
 ):
     """Sequential microbatch loop with gradient accumulation.
@@ -229,18 +259,21 @@ def forward_backward_no_pipelining(
     m = inputs.shape[0]
     body = _maybe_checkpoint(stage_fn, checkpoint_stages)
     has_extra = extra_params is not None
+    tmr = _start_timer(timers, forward_only)
 
     def one_loss(p, extra, x, t):
-        x0 = pre_fn(extra, x) if pre_fn is not None else x
-        y = body(p, x0)
-        return loss_fn(extra, y, t) if has_extra else loss_fn(y, t)
+        with jax.named_scope("pp_fwd"):
+            x0 = pre_fn(extra, x) if pre_fn is not None else x
+            y = body(p, x0)
+        with jax.named_scope("pp_head"):
+            return loss_fn(extra, y, t) if has_extra else loss_fn(y, t)
 
     if forward_only:
         losses = jax.lax.map(
             lambda xt: one_loss(params, extra_params, xt[0], xt[1]),
             (inputs, targets),
         )
-        return losses, None
+        return _finish_timer(tmr, (losses, None))
 
     argnums = (0, 1) if has_extra else 0
 
@@ -270,8 +303,8 @@ def forward_backward_no_pipelining(
         step, (zero, zero_e), (inputs, targets)
     )
     if has_extra:
-        return losses, (grads, egrads)
-    return losses, grads
+        return _finish_timer(tmr, (losses, (grads, egrads)))
+    return _finish_timer(tmr, (losses, grads))
 
 
 def _tree_idx(tree, i):
@@ -316,6 +349,7 @@ def forward_backward_pipelining_without_interleaving(
     axis_name: Optional[str] = None,
     extra_params: Any = None,
     pre_fn=None,
+    timers=None,
     **unused_kw,
 ):
     """The 1F1B linear pipeline.
@@ -365,7 +399,8 @@ def forward_backward_pipelining_without_interleaving(
             act_recv, y_buf = carry
             mb_in = jnp.clip(t, 0, m - 1)
             x = jnp.where(is_first, x0_all[mb_in], act_recv)
-            y = body(local_params, x)
+            with jax.named_scope("pp_fwd"):
+                y = body(local_params, x)
             # Output collection on the last stage: tick t completes
             # microbatch t-(P-1). The head/loss is NOT applied here —
             # outputs buffer up and post_process runs once after the
@@ -376,7 +411,8 @@ def forward_backward_pipelining_without_interleaving(
             y_buf = y_buf.at[mb_out_c].set(
                 jnp.where(valid, y, y_buf[mb_out_c])
             )
-            sent = jax.lax.ppermute(y, axis, perm)
+            with jax.named_scope("pp_comm"):
+                sent = jax.lax.ppermute(y, axis, perm)
             return (sent, y_buf), None
 
         act0 = pcast_varying(jnp.zeros(a0.shape, a0.dtype), axis)
@@ -394,9 +430,10 @@ def forward_backward_pipelining_without_interleaving(
         )
         return jnp.mean(loss_buf), loss_buf
 
+    tmr = _start_timer(timers, forward_only)
     if forward_only:
         _, losses = run(local_params, extra_params)
-        return losses, None
+        return _finish_timer(tmr, (losses, None))
     losses, grads, egrads = _one_pass_1f1b(
         stage_fn, loss_fn, local_params, inputs, targets, axis,
         extra_params, pre_fn, has_extra,
@@ -408,8 +445,8 @@ def forward_backward_pipelining_without_interleaving(
         # egrads are per-stage partials summed over the axis inside
         # _one_pass_1f1b — the reference's embedding-group allreduce
         # (parallel_state embedding group = first + last stage)
-        return losses, (grads, egrads)
-    return losses, grads
+        return _finish_timer(tmr, (losses, (grads, egrads)))
+    return _finish_timer(tmr, (losses, grads))
 
 
 def _one_pass_interleaved(
@@ -512,7 +549,8 @@ def _one_pass_interleaved(
                 ),
             )
         x_in = jnp.where(is_entry, x0, act_recv)
-        y = stage_fn(chunk, x_in)
+        with jax.named_scope("pp_fwd"):
+            y = stage_fn(chunk, x_in)
 
         # exit-unit post_process (global stage G-1)
         is_exit = is_last & (v_fc == vp - 1) & fwd_valid
@@ -549,7 +587,8 @@ def _one_pass_interleaved(
                 eg_acc,
             )
 
-        (loss_j, dy), eg_acc = jax.lax.cond(is_exit, _head, _nohead)
+        with jax.named_scope("pp_head"):
+            (loss_j, dy), eg_acc = jax.lax.cond(is_exit, _head, _nohead)
         losses = losses.at[m_fc].set(
             jnp.where(is_exit, loss_j, losses[m_fc])
         )
@@ -565,8 +604,9 @@ def _one_pass_interleaved(
         x_saved = jnp.where(bwd_is_exit, x_in, x_buf[slot_b])
         ct_in = jnp.where(bwd_is_exit, dy.astype(y.dtype), ct_recv)
         bchunk = chunk_at(params, v_bc)
-        _, pull = jax.vjp(stage_fn, bchunk, x_saved)
-        dp_j, dx_j = pull(ct_in)
+        with jax.named_scope("pp_bwd"):
+            _, pull = jax.vjp(stage_fn, bchunk, x_saved)
+            dp_j, dx_j = pull(ct_in)
         g_acc = jax.tree_util.tree_map(
             lambda a, d: jax.lax.dynamic_update_index_in_dim(
                 a,
@@ -603,10 +643,12 @@ def _one_pass_interleaved(
                 x_buf[slot_f],
             )
         )
-        act_send = jax.lax.ppermute(y, axis, ring)
-        ct_send = jax.lax.ppermute(
-            jnp.where(bwd_valid, dx_j, jnp.zeros_like(dx_j)), axis, rring
-        )
+        with jax.named_scope("pp_comm"):
+            act_send = jax.lax.ppermute(y, axis, ring)
+            ct_send = jax.lax.ppermute(
+                jnp.where(bwd_valid, dx_j, jnp.zeros_like(dx_j)),
+                axis, rring,
+            )
         return (act_send, ct_send, x_buf, g_acc, eg_acc, losses), None
 
     act0 = varying(jnp.zeros(a0.shape, a0.dtype))
@@ -647,6 +689,7 @@ def forward_backward_pipelining_with_interleaving(
     axis_name: Optional[str] = None,
     extra_params: Any = None,
     pre_fn=None,
+    timers=None,
     **unused_kw,
 ):
     """Interleaved virtual stages as a circular pipeline.
@@ -719,10 +762,12 @@ def forward_backward_pipelining_with_interleaving(
             )
             is_entry = is_first & (v_c == 0)
             x = jnp.where(is_entry, x0_all[mb_c], act_recv)
-            y = body(chunk, x)
+            with jax.named_scope("pp_fwd"):
+                y = body(chunk, x)
             is_exit = is_last & (v_c == vp - 1) & valid
             y_buf = y_buf.at[mb_c].set(jnp.where(is_exit, y, y_buf[mb_c]))
-            sent = jax.lax.ppermute(y, axis, ring)
+            with jax.named_scope("pp_comm"):
+                sent = jax.lax.ppermute(y, axis, ring)
             return (sent, y_buf), None
 
         act0 = pcast_varying(jnp.zeros(a0.shape, a0.dtype), axis)
@@ -736,13 +781,14 @@ def forward_backward_pipelining_with_interleaving(
         )
         return jnp.mean(loss_buf), loss_buf
 
+    tmr = _start_timer(timers, forward_only)
     if forward_only:
         _, losses = run(params, extra_params)
-        return losses, None
+        return _finish_timer(tmr, (losses, None))
     losses, grads, egrads = _one_pass_interleaved(
         stage_fn, loss_fn, params, inputs, targets, axis,
         extra_params, pre_fn, has_extra, vp,
     )
     if has_extra:
-        return losses, (grads, egrads)
-    return losses, grads
+        return _finish_timer(tmr, (losses, (grads, egrads)))
+    return _finish_timer(tmr, (losses, grads))

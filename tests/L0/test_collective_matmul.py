@@ -15,7 +15,11 @@ contract tested here:
   - the jaxpr proof for the acceptance bar: the sequence-parallel GPT
     stack with collective_matmul=True contains NO full-sequence
     (b, s, hidden) gathered activation between the regions — while the
-    blocking-collective variant (the probe's sanity check) does.
+    blocking-collective variant (the probe's sanity check) does. The
+    probe is the shared static auditor (rocm_apex_tpu.monitor.audit),
+    which replaced this file's original string-greps over
+    str(make_jaxpr(...)); test_monitor.py additionally pins the ring's
+    exact ppermute counts on the same config.
 """
 
 import jax
@@ -32,6 +36,7 @@ from rocm_apex_tpu.models.gpt import (
     ParallelTransformer,
     gpt_pipeline_functions,
 )
+from rocm_apex_tpu.monitor import assert_no_intermediate, audit
 from rocm_apex_tpu.ops.collective_matmul import (
     all_gather_matmul,
     matmul_reduce_scatter,
@@ -390,11 +395,12 @@ class TestPipelineExitStage:
 class TestNoGatheredActivationInJaxpr:
     B, S, H = 2, 32, 64
 
-    def _stack_ir(self, collective_matmul, chunk=None):
-        """Jaxpr of init + fwd + bwd of the sequence-parallel stack on
-        a local sequence shard — the activations BETWEEN the regions,
-        embedding and head excluded (those are the region boundaries,
-        where one full-sequence tensor is definitional)."""
+    def _stack_report(self, collective_matmul, chunk=None):
+        """`monitor.audit` report of init + fwd + bwd of the sequence-
+        parallel stack on a local sequence shard — the activations
+        BETWEEN the regions, embedding and head excluded (those are the
+        region boundaries, where one full-sequence tensor is
+        definitional). Abstract tracing only: nothing compiles."""
         mesh = _mesh(2)
         cfg = _sp_cfg(collective_matmul, collective_matmul_chunk=chunk)
         stack = ParallelTransformer(cfg)
@@ -413,34 +419,43 @@ class TestNoGatheredActivationInJaxpr:
             step, mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
             check_rep=False,
         )
-        return str(jax.make_jaxpr(f)(x_loc))
+        return audit(f, x_loc)
 
     def test_collective_matmul_stack_has_no_full_activation(self):
         """The acceptance bar made executable: with the ring boundary
         matmuls, no (b, s, hidden) full-sequence activation exists
         anywhere in the traced train step of the stack — only
         (b, s/tp, hidden) shards and full-sequence tensors of OTHER
-        widths (the qkv/ffn shards attention consumes). The blocking-
-        collective variant, traced identically, does contain it (so
-        the probe itself is sound)."""
-        full = f"{self.B},{self.S},{self.H}]"
-        shard = f"{self.B},{self.S // 2},{self.H}]"
-        ir_blocking = self._stack_ir(collective_matmul=False)
-        assert full in ir_blocking  # probe sanity: the gather exists
-        ir_ring = self._stack_ir(collective_matmul=True)
-        assert shard in ir_ring
-        assert full not in ir_ring
+        widths (the qkv/ffn shards attention consumes) — and the edge
+        collectives really are rings (ppermute, no all_gather/
+        reduce_scatter). The blocking-collective variant, audited
+        identically, does contain the gather (so the probe itself is
+        sound)."""
+        full = (self.B, self.S, self.H)
+        blocking = self._stack_report(collective_matmul=False)
+        # probe sanity: the gather exists and uses plain collectives
+        assert blocking.has_intermediate(full)
+        assert blocking.count("all_gather") > 0
+        assert blocking.count("ppermute") == 0
+        ring = assert_no_intermediate(
+            self._stack_report(collective_matmul=True), full
+        )
+        assert ring.has_intermediate((self.B, self.S // 2, self.H))
+        assert ring.count("ppermute") > 0
+        assert ring.count("all_gather") == 0
+        assert ring.count("reduce_scatter") == 0
 
     def test_chunked_ring_also_clean(self):
-        full = f"{self.B},{self.S},{self.H}]"
-        ir = self._stack_ir(collective_matmul=True, chunk=8)
-        assert full not in ir
+        assert_no_intermediate(
+            self._stack_report(collective_matmul=True, chunk=8),
+            (self.B, self.S, self.H),
+        )
 
     def test_no_async_flag_disables_the_ring(self):
         """`no_async_tensor_model_parallel_allreduce=True` is the
         reference's opt-out of comm/compute overlap: with it, the
         column entry goes back to the blocking gather — the full
-        gathered input reappears in the jaxpr."""
+        gathered input reappears, and no ring permutes remain."""
         mesh = _mesh(2)
         layer = ColumnParallelLinear(
             input_size=self.H,
@@ -462,5 +477,6 @@ class TestNoGatheredActivationInJaxpr:
             step, mesh=mesh, in_specs=(P(),), out_specs=P(),
             check_rep=False,
         )
-        ir = str(jax.make_jaxpr(f)(x_loc))
-        assert f"{self.B},{self.S},{self.H}]" in ir
+        report = audit(f, x_loc)
+        assert report.has_intermediate((self.B, self.S, self.H))
+        assert report.count("ppermute") == 0

@@ -97,6 +97,104 @@ class TestTimers:
         t.log(["fwd"], printer=lines.append)
         assert "fwd" in lines[0]
 
+    def test_write_resets_by_default_like_log(self):
+        """The log/write default-reset unification: both sinks reset
+        what they report, so stdout and TensorBoard can never disagree
+        about the window a value covers."""
+
+        class Sink:
+            rows = []
+
+            def add_scalar(self, tag, value, step):
+                self.rows.append((tag, value, step))
+
+        t = Timers()
+        t("step").start()
+        t("step").stop()
+        sink = Sink()
+        t.write(["step"], sink, iteration=1)
+        assert sink.rows and sink.rows[0][0] == "step-time"
+        assert t("step").elapsed(reset=False) == 0.0  # write reset it
+        # cumulative reporting stays available explicitly
+        t("step").start()
+        t("step").stop()
+        t.write(["step"], sink, iteration=2, reset=False)
+        assert t("step").elapsed(reset=False) > 0.0
+
+    def test_sync_on_passthrough(self):
+        """`sync_on` reaches the stop of a STILL-RUNNING timer through
+        both sinks (the true-device-sync treatment `log` documented;
+        `write` now gets the same)."""
+        import jax.numpy as jnp
+
+        t = Timers()
+        val = jnp.float32(1.0)
+        t("w").start()
+        rows = []
+
+        class Sink:
+            def add_scalar(self, tag, value, step):
+                rows.append((tag, value, step))
+
+        t.write(["w"], Sink(), iteration=0, sync_on=val)
+        assert rows[0][1] >= 0.0
+        assert t("w").started_  # elapsed() restarts a running timer
+        t("w").stop()
+        t("l").start()
+        lines = []
+        t.log(["l"], printer=lines.append, sync_on=val)
+        assert "l" in lines[0]
+
+
+class TestLogUtil:
+    def test_distinct_modules_distinct_loggers(self):
+        """The basename-collision fix: two modules whose dotted paths
+        differ only above the final component must NOT share a logger
+        (setting a level for one used to silence the other)."""
+        from rocm_apex_tpu.transformer.log_util import (
+            get_transformer_logger,
+        )
+
+        a = get_transformer_logger(
+            "rocm_apex_tpu.transformer.pipeline_parallel.utils"
+        )
+        b = get_transformer_logger(
+            "rocm_apex_tpu.transformer.tensor_parallel.utils"
+        )
+        assert a is not b
+        assert a.name != b.name
+        assert a.name.startswith("rocm_apex_tpu.transformer.")
+        assert b.name.startswith("rocm_apex_tpu.transformer.")
+
+    def test_internal_prefixes_nest_without_duplication(self):
+        from rocm_apex_tpu.transformer.log_util import (
+            get_transformer_logger,
+        )
+
+        lg = get_transformer_logger("rocm_apex_tpu.transformer.moe")
+        assert lg.name == "rocm_apex_tpu.transformer.moe"
+        lg2 = get_transformer_logger("rocm_apex_tpu.models.gpt")
+        assert lg2.name == "rocm_apex_tpu.transformer.models.gpt"
+        lg3 = get_transformer_logger("myapp.utils")
+        assert lg3.name == "rocm_apex_tpu.transformer.myapp.utils"
+
+    def test_set_logging_level_reaches_children(self):
+        import logging
+
+        from rocm_apex_tpu.transformer.log_util import (
+            get_transformer_logger,
+            set_logging_level,
+        )
+
+        child = get_transformer_logger(
+            "rocm_apex_tpu.transformer.pipeline_parallel.schedules"
+        )
+        set_logging_level(logging.ERROR)
+        try:
+            assert child.getEffectiveLevel() == logging.ERROR
+        finally:
+            set_logging_level(logging.WARNING)
+
 
 CORE = [
     "--num-layers", "4", "--hidden-size", "64",

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Tier-1 wall-time budget guard.
+
+The tier-1 suite runs against a hard 870 s driver timeout with ~25 s of
+cold-compile slack (ROADMAP open items); a PR that adds a handful of
+novel-shape tests silently spends that margin and the NEXT PR times
+out. This guard makes the margin a tracked metric:
+
+* ``tests/conftest.py`` dumps per-test durations to
+  ``/tmp/_t1_durations.json`` after every pytest session;
+* ``tools/tier1_budget.json`` is the checked-in baseline — the known
+  test ids (with their reference durations) and the new-test budget;
+* this script diffs the dump against the baseline and FAILS (exit 1)
+  when tests not in the baseline add more than the budgeted seconds
+  (default 20 — under the ~25 s slack, measured cold on the 1-core
+  box).
+
+Usage:
+    python -m pytest tests/ -q -m 'not slow'     # writes the dump
+    python tools/check_tier1_budget.py           # guard
+    python tools/check_tier1_budget.py --update  # re-baseline (after a
+                                                 # reviewed, intended
+                                                 # budget change)
+
+The guard is advisory about REMOVED tests and total drift (prints,
+never fails on them): a warm compilation cache makes totals
+incomparable across boxes, but a brand-new test is cold everywhere.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+BUDGET_PATH = REPO / "tools" / "tier1_budget.json"
+DEFAULT_DUMP = "/tmp/_t1_durations.json"
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", nargs="?", default=DEFAULT_DUMP,
+                    help="per-test durations dump (conftest output)")
+    ap.add_argument("--budget", default=str(BUDGET_PATH),
+                    help="checked-in baseline file")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from the dump")
+    args = ap.parse_args()
+
+    try:
+        dump = load(args.dump)
+    except OSError as e:
+        print(f"no durations dump: {e}\nrun the tier-1 suite first "
+              "(tests/conftest.py writes it)", file=sys.stderr)
+        return 2
+    durations = dump["durations"]
+
+    if args.update:
+        with open(args.budget, "w") as f:
+            json.dump(
+                {
+                    "new_test_budget_seconds": 20.0,
+                    "reference_total_seconds": round(
+                        sum(durations.values()), 1
+                    ),
+                    "tests": {k: round(v, 2)
+                              for k, v in sorted(durations.items())},
+                },
+                f, indent=0, sort_keys=True,
+            )
+            f.write("\n")
+        print(f"baseline rewritten: {len(durations)} tests, "
+              f"{sum(durations.values()):.1f} s -> {args.budget}")
+        return 0
+
+    try:
+        budget = load(args.budget)
+    except OSError as e:
+        print(f"no baseline: {e}\nbootstrap with --update after a full "
+              "tier-1 run", file=sys.stderr)
+        return 2
+
+    known = budget["tests"]
+    limit = float(budget.get("new_test_budget_seconds", 20.0))
+    new = {k: v for k, v in durations.items() if k not in known}
+    removed = sorted(k for k in known if k not in durations)
+    new_total = sum(new.values())
+    total = sum(durations.values())
+    ref_total = float(budget.get("reference_total_seconds", 0.0))
+
+    print(f"tier-1 durations: {len(durations)} tests, {total:.1f} s "
+          f"(baseline {len(known)} tests, {ref_total:.1f} s)")
+    if removed:
+        print(f"  {len(removed)} baseline tests absent from this run "
+              "(renamed/removed, or a partial run)")
+    if new:
+        print(f"  {len(new)} new tests, {new_total:.1f} s "
+              f"(budget {limit:.0f} s):")
+        for k, v in sorted(new.items(), key=lambda kv: -kv[1])[:20]:
+            print(f"    {v:7.2f}s  {k}")
+    if new_total > limit:
+        print(f"FAIL: new tests add {new_total:.1f} s > {limit:.0f} s "
+              "budget.\nPrefer reusing existing test configs "
+              "(compile-cache hits) and scan-over-stacked-layers serial "
+              "references (ROADMAP); if the cost is justified, "
+              "re-baseline with --update in the same PR and say so in "
+              "the PR description.")
+        return 1
+    print("OK: within the new-test budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
