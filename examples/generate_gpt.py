@@ -2,17 +2,21 @@
 
 The serving-side counterpart of `examples/gpt_train.py`: builds a GPT,
 leases cache slots to a queue of mixed-length requests, and drives the
-engine's admit → decode → evict loop, printing per-request outputs and
-aggregate decode throughput. With random init the tokens are noise —
-the point is the serving machinery: one compiled prefill, ONE compiled
-decode step reused across every tick (the trace counters printed at
-the end must both read 1), per-slot KV cache reuse.
+engine's admit → prefill-chunk → decode → evict loop, printing
+per-request outputs and aggregate serving throughput. With random init
+the tokens are noise — the point is the serving machinery: the
+token-budget chunked-prefill scheduler packs pending prompt tokens
+into ONE compiled mixed chunk+decode step per tick (the trace counters
+printed at the end must stay at 1), prompts longer than any pad width
+stream through in budget-sized pieces, and decodes never stall behind
+a prefill. ``--token-budget 0`` selects the legacy whole-prompt
+prefill (the A/B baseline, pad width ``--max-prompt-len``).
 
 CPU smoke:
     JAX_PLATFORMS=cpu python examples/generate_gpt.py \
         --num-layers 2 --hidden-size 64 --num-attention-heads 4 \
         --max-seq-len 64 --num-slots 2 --num-requests 6 \
-        --max-new-tokens 8
+        --max-new-tokens 8 --token-budget 6
 """
 
 import argparse
@@ -37,7 +41,17 @@ def main():
     p.add_argument("--vocab-size", type=int, default=512)
     p.add_argument("--max-seq-len", type=int, default=64,
                    help="cache capacity == max_position_embeddings")
-    p.add_argument("--max-prompt-len", type=int, default=16)
+    p.add_argument("--max-prompt-len", type=int, default=16,
+                   help="prompt-length cap for the RANDOM workload "
+                        "below; also the pad width of the legacy "
+                        "whole-prompt path (--token-budget 0)")
+    p.add_argument("--token-budget", type=int, default=16,
+                   help="prefill tokens absorbed per engine tick "
+                        "(chunked-prefill scheduler); 0 = legacy "
+                        "whole-prompt prefill")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="optional cap on tokens taken from ONE "
+                        "request per tick (fairness inside the budget)")
     p.add_argument("--num-slots", type=int, default=2)
     p.add_argument("--num-requests", type=int, default=6)
     p.add_argument("--max-new-tokens", type=int, default=8)
@@ -66,8 +80,10 @@ def main():
     n_params = sum(
         x.size for x in jax.tree_util.tree_leaves(params)
     )
+    chunked = args.token_budget > 0
     print(f"model: {n_params / 1e6:.1f}M params, "
-          f"{jax.default_backend()} backend")
+          f"{jax.default_backend()} backend, "
+          f"prefill={'budget %d' % args.token_budget if chunked else 'whole-prompt'}")
 
     eng = InferenceEngine(
         model, params,
@@ -80,6 +96,8 @@ def main():
             top_p=args.top_p,
         ),
         seed=args.seed,
+        prefill_token_budget=args.token_budget if chunked else None,
+        prefill_chunk=args.prefill_chunk,
     )
 
     rng = np.random.RandomState(args.seed)
@@ -97,12 +115,22 @@ def main():
     for r in results:
         print(f"req {r.request_id}: prompt[{len(r.prompt)}] -> "
               f"{r.tokens} ({r.finish_reason})")
+    s = eng.stats()
     print(f"generated {n_gen} tokens across {len(results)} requests "
           f"in {dt:.2f}s ({n_gen / dt:.1f} tok/s) | "
-          f"prefill traces={eng.prefill_trace_count} "
-          f"decode traces={eng.decode_trace_count}")
-    if eng.decode_trace_count != 1 or eng.prefill_trace_count != 1:
-        raise SystemExit("decode/prefill retraced — serving loop broken")
+          f"ttft p50/p95={s['ttft_ms_p50']:.0f}/{s['ttft_ms_p95']:.0f}ms | "
+          f"traces: mixed={eng.mixed_trace_count} "
+          f"decode={eng.decode_trace_count} "
+          f"prefill={eng.prefill_trace_count}")
+    if chunked:
+        # the fixed-shape contract: ONE mixed program for the whole
+        # run regardless of the prompt mix (+ at most one decode-only
+        # fast-path program)
+        ok = eng.mixed_trace_count == 1 and eng.decode_trace_count <= 1
+    else:
+        ok = eng.decode_trace_count == 1 and eng.prefill_trace_count == 1
+    if not ok:
+        raise SystemExit("serving programs retraced — scheduler broken")
 
 
 if __name__ == "__main__":

@@ -9,8 +9,10 @@ nothing is forked:
     sampling   greedy / temperature / top-k / top-p, jit-able and
                seed-deterministic
     engine     continuous-batching serving loop: fixed slot grid,
-               request queue, per-step admit/evict, ONE compiled
-               decode_step with donated cache buffers
+               request queue, per-step admit/evict, and the chunked-
+               prefill token-budget scheduler — ONE compiled mixed
+               chunk+decode step per tick (plus a decode-only fast
+               path), donated cache buffers, no prompt-length ceiling
 
 The model side lives in `models/gpt.py` (``cache=`` on `GPTModel`) and
 `ops/flash_attention.py` (`flash_attention_decode`); this package owns

@@ -4,21 +4,36 @@ The serving loop the ROADMAP's "heavy traffic" story needs: a fixed
 grid of batch slots (the preallocated `KVCache`), a host-side request
 queue, and per-step admit/evict — a finished sequence frees its slot
 at the end of a step and a queued request claims it at the start of
-the next, so the compiled decode program never changes shape while the
-set of in-flight requests churns (the continuous-batching design of
-modern LLM servers, compiled-program-friendly).
+the next, so the compiled programs never change shape while the set of
+in-flight requests churns (the continuous-batching design of modern
+LLM servers, compiled-program-friendly).
 
-Two compiled programs serve everything:
+Prefill is CHUNKED by default (the Sarathi-Serve / Orca design point,
+arXiv:2403.02310): each tick the scheduler packs up to
+``prefill_token_budget`` pending prompt tokens — pieces of one or more
+queued or partially-prefilled requests, tracked by a per-slot prefill
+cursor — into one fixed-shape ``(budget,)`` buffer with per-token slot
+ids and positions, and runs ONE compiled **mixed step** that
 
-* ``prefill``: one request's padded prompt through the model against a
-  single-slot cache view, scattered back into the full cache, first
-  token sampled from the last REAL prompt position. Traced once (the
-  prompt pad width is fixed at construction).
-* ``decode_step``: ONE token for EVERY slot — active or not — in a
-  single jit program with the cache buffers donated, so the per-token
-  cost is one program dispatch and in-place cache writes, no per-token
-  Python dispatch into XLA and no cache copies. Traced once; the
-  engine exposes ``decode_trace_count`` so tests pin that invariant.
+* attends the packed chunk against each slot's existing cache prefix
+  plus intra-chunk causality (models/gpt.py chunk path: the packed
+  varlen segments kernel merged with the chunk-width cache read),
+* scatters the chunk's K/V into the cache at per-slot offsets
+  (`KVCache.write_at` semantics), and
+* advances the WHOLE decode grid in the same program,
+
+so decodes never wait on a prefill (no head-of-line blocking), prompts
+of ANY length stream through in budget-sized pieces (there is no
+admit-time prompt-length ceiling — only the physical cache capacity),
+and no padded ``(1, max_prompt_len, …)`` activation ever materializes.
+Ticks with no pending prompt tokens take a decode-only fast path (the
+same compiled decode program every tick). Fixed shapes mean exactly
+ONE mixed-step trace for a whole serving run regardless of the prompt
+mix — ``mixed_trace_count`` pins that invariant in tests.
+
+``prefill_token_budget=None`` restores the legacy whole-prompt path
+(one padded compiled prefill per request) as the A/B baseline the
+serving bench measures against.
 
 Inactive slots ride along as dead rows (their sampled tokens are
 discarded and their lengths pinned) — uniform shapes beat ragged
@@ -55,7 +70,7 @@ __all__ = [
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Static sampling config — fixed per engine (it is baked into the
-    compiled decode program). ``temperature=0`` is greedy."""
+    compiled programs). ``temperature=0`` is greedy."""
 
     temperature: float = 1.0
     top_k: Optional[int] = None
@@ -67,6 +82,9 @@ class Request:
     request_id: int
     prompt: List[int]
     max_new_tokens: int
+    # enqueue wall time (perf_counter domain) — the anchor for the
+    # queue-wait and TTFT percentiles in `stats()`
+    enqueued_at: float = 0.0
 
 
 @dataclasses.dataclass
@@ -84,6 +102,11 @@ class _Slot:
     req: Request
     generated: List[int]
     pos: int  # tokens materialized in the cache for this slot
+    cursor: int = 0  # prompt tokens committed to the cache so far
+
+    @property
+    def prefilling(self) -> bool:
+        return self.cursor < len(self.req.prompt)
 
 
 class InferenceEngine:
@@ -93,6 +116,18 @@ class InferenceEngine:
     (the same pytree `GPTModel.init` returns — serving reuses the
     training checkpoint directly). The cache dtype defaults to the
     model's compute dtype (bf16 under the O4/O5 recipe).
+
+    ``prefill_token_budget`` (default 64) is the chunked-prefill
+    scheduler knob: prompt tokens absorbed per tick, across requests.
+    Larger budgets raise prefill throughput (fewer, wider chunks);
+    smaller budgets cut time-to-first-token jitter for the decodes
+    sharing the tick — see docs/inference.md for the trade.
+    ``prefill_chunk`` optionally caps the tokens taken from ONE
+    request per tick (a fairness knob inside the budget).
+    ``prefill_token_budget=None`` selects the legacy whole-prompt
+    prefill (one padded compiled call per request, pad width
+    ``max_prompt_len``) — the A/B baseline; only this path has a
+    prompt-length ceiling.
 
     Single-chip (tp=1) in this PR; the cache layout already stores
     LOCAL head shards, so multi-chip sharded serving is a cache-
@@ -111,6 +146,8 @@ class InferenceEngine:
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
         cache_dtype: Any = None,
+        prefill_token_budget: Optional[int] = 64,
+        prefill_chunk: Optional[int] = None,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -132,6 +169,22 @@ class InferenceEngine:
                 f"max_prompt_len {self.max_prompt_len} must be in "
                 f"(0, capacity={self.capacity}]"
             )
+        if prefill_token_budget is not None and prefill_token_budget < 1:
+            raise ValueError(
+                f"prefill_token_budget must be >= 1 (or None for the "
+                f"whole-prompt path), got {prefill_token_budget}"
+            )
+        self.prefill_token_budget = (
+            int(prefill_token_budget)
+            if prefill_token_budget is not None else None
+        )
+        self.prefill_chunk = (
+            int(prefill_chunk) if prefill_chunk is not None else None
+        )
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
         self.eos_id = eos_id
         self.sampling = sampling or SamplingParams()
         self.cache = KVCache.for_model(
@@ -143,11 +196,15 @@ class InferenceEngine:
         self._next_id = 0
         self._prefill_traces = 0
         self._decode_traces = 0
+        self._mixed_traces = 0
         # serving telemetry (read via `stats()`, fed to a
         # monitor.MetricsLogger): monotonic counters + wall-time sums.
         # Latencies include the result fetch — on the tunnel platform
         # that fetch IS the device sync (the Timers rule), so these are
-        # true end-to-end numbers, not dispatch times.
+        # true end-to-end numbers, not dispatch times. Per-request
+        # queue waits (enqueue -> slot lease) and TTFTs (enqueue ->
+        # first token) feed the p50/p95 fields that surface the
+        # head-of-line blocking the chunked scheduler removes.
         self._admitted = 0
         self._evicted = 0
         self._prompt_tokens = 0
@@ -155,6 +212,9 @@ class InferenceEngine:
         self._prefill_seconds = 0.0
         self._decode_seconds = 0.0
         self._decode_steps = 0
+        self._mixed_steps = 0
+        self._queue_waits: List[float] = []
+        self._ttfts: List[float] = []
 
         sp = self.sampling
 
@@ -186,8 +246,7 @@ class InferenceEngine:
             first_tok = _sample(rng, last[None, :])[0]
             return first_tok, cache
 
-        def _decode(params, cache, tokens, active, rng):
-            self._decode_traces += 1
+        def _decode_body(params, cache, tokens, active, rng):
             logits, new_cache = model.apply(
                 params, tokens[:, None], cache=cache
             )
@@ -202,12 +261,62 @@ class InferenceEngine:
             tok = _sample(rng, logits[:, -1, :])
             return jnp.where(active, tok, 0), new_cache
 
+        def _decode(params, cache, tokens, active, rng):
+            self._decode_traces += 1
+            return _decode_body(params, cache, tokens, active, rng)
+
+        def _mixed(
+            params, cache, chunk_tokens, chunk_slots, chunk_pos,
+            lengths_before, lengths_after, completion_idx,
+            dec_tokens, dec_active, rng,
+        ):
+            """ONE compiled program per tick: packed prefill chunk +
+            the whole decode grid. The host is the source of truth for
+            per-slot lengths (a freed slot's stale device length must
+            never bound a successor's reads), so the cursor vectors
+            ride in as arguments. ``completion_idx[slot]`` is the chunk
+            index of the slot's LAST prompt token when its prefill
+            completes this tick (else -1): its sampled first token is
+            fed STRAIGHT into the decode grid, so a completing request
+            gets its second token in the same tick — exactly the
+            whole-prompt path's admit-tick cadence, with no padded
+            prefill."""
+            self._mixed_traces += 1
+            rng_c, rng_d = jax.random.split(rng)
+            cache = cache.replace(lengths=lengths_before)
+            logits_c, cache = model.apply(
+                params,
+                chunk_tokens[None, :],
+                cache=cache,
+                chunk=(chunk_slots, chunk_pos),
+            )
+            # sample EVERY chunk position (fixed shape); the host keeps
+            # only the positions that completed a prompt this tick
+            chunk_tok = _sample(rng_c, logits_c[0])
+            # commit the chunk: cursors advance by what was packed
+            cache = cache.replace(lengths=lengths_after)
+            budget = chunk_tokens.shape[0]
+            has_comp = completion_idx >= 0
+            first_tok = chunk_tok[
+                jnp.clip(completion_idx, 0, budget - 1)
+            ]
+            dec_tokens = jnp.where(has_comp, first_tok, dec_tokens)
+            dec_active = dec_active | has_comp
+            dec_tok, cache = _decode_body(
+                params, cache, dec_tokens, dec_active, rng_d
+            )
+            return chunk_tok, dec_tok, cache
+
         # cache buffers are DONATED: the step updates them in place on
         # TPU. CPU (the test platform) cannot donate and would warn on
         # every call, so donation is gated on the backend.
         donate = (1,) if on_tpu() else ()
+        self._prefill_fn = _prefill
+        self._decode_fn = _decode_body
+        self._mixed_fn = _mixed
         self._prefill_jit = jax.jit(_prefill, donate_argnums=donate)
         self._decode_jit = jax.jit(_decode, donate_argnums=donate)
+        self._mixed_jit = jax.jit(_mixed, donate_argnums=donate)
 
     # ------------------------------------------------------------------
     # public API
@@ -226,12 +335,20 @@ class InferenceEngine:
         return len(self._queue)
 
     @property
+    def chunked(self) -> bool:
+        return self.prefill_token_budget is not None
+
+    @property
     def prefill_trace_count(self) -> int:
         return self._prefill_traces
 
     @property
     def decode_trace_count(self) -> int:
         return self._decode_traces
+
+    @property
+    def mixed_trace_count(self) -> int:
+        return self._mixed_traces
 
     def has_work(self) -> bool:
         return bool(self._queue) or self.num_active > 0
@@ -243,19 +360,31 @@ class InferenceEngine:
 
         Gauges: ``queue_depth``, ``slots_active``, ``slot_occupancy``.
         Counters: ``admitted``, ``evicted``, ``prompt_tokens``,
-        ``generated_tokens``, ``decode_steps``. Derived: mean
-        prefill/decode latency (ms, sync-inclusive — see __init__) and
-        tokens/sec over each phase's accumulated wall time
-        (prefill = prompt tokens absorbed, decode = tokens emitted)."""
+        ``generated_tokens``, ``decode_steps``, ``mixed_steps``.
+        Derived: mean latency per prefill-carrying tick
+        (``prefill_ms_avg`` — a whole-prompt admit in legacy mode, a
+        mixed chunk+decode tick in chunked mode), mean decode-only
+        tick latency, and tokens/sec over each phase's accumulated
+        wall time. Per-request distributions: ``queue_wait_ms_p50/95``
+        (enqueue → slot lease) and ``ttft_ms_p50/95`` (enqueue →
+        first token) — the tails that surface head-of-line blocking,
+        which the averages above hide."""
+        prefill_ticks = (
+            self._mixed_steps if self.chunked else self._admitted
+        )
         prefill_ms = (
-            1e3 * self._prefill_seconds / self._admitted
-            if self._admitted else 0.0
+            1e3 * self._prefill_seconds / prefill_ticks
+            if prefill_ticks else 0.0
         )
         decode_ms = (
             1e3 * self._decode_seconds / self._decode_steps
             if self._decode_steps else 0.0
         )
         decode_generated = self._generated_tokens - self._admitted
+
+        def _pct(values, q):
+            return float(np.percentile(values, q)) if values else 0.0
+
         return {
             "queue_depth": float(self.num_queued),
             "slots_active": float(self.num_active),
@@ -265,6 +394,7 @@ class InferenceEngine:
             "prompt_tokens": float(self._prompt_tokens),
             "generated_tokens": float(self._generated_tokens),
             "decode_steps": float(self._decode_steps),
+            "mixed_steps": float(self._mixed_steps),
             "prefill_ms_avg": prefill_ms,
             "decode_ms_avg": decode_ms,
             "prefill_tokens_per_sec": (
@@ -275,7 +405,27 @@ class InferenceEngine:
                 decode_generated / self._decode_seconds
                 if self._decode_seconds > 0 else 0.0
             ),
+            "queue_wait_ms_p50": 1e3 * _pct(self._queue_waits, 50),
+            "queue_wait_ms_p95": 1e3 * _pct(self._queue_waits, 95),
+            "ttft_ms_p50": 1e3 * _pct(self._ttfts, 50),
+            "ttft_ms_p95": 1e3 * _pct(self._ttfts, 95),
         }
+
+    def reset_stats(self) -> None:
+        """Zero the telemetry counters and per-request distributions.
+        Compiled programs, trace counters, and cache state are
+        untouched — benchmarks warm the compiles up on the same engine,
+        then measure a clean window."""
+        self._admitted = 0
+        self._evicted = 0
+        self._prompt_tokens = 0
+        self._generated_tokens = 0
+        self._prefill_seconds = 0.0
+        self._decode_seconds = 0.0
+        self._decode_steps = 0
+        self._mixed_steps = 0
+        self._queue_waits = []
+        self._ttfts = []
 
     def add_request(
         self,
@@ -284,40 +434,230 @@ class InferenceEngine:
         request_id: Optional[int] = None,
     ) -> int:
         """Queue a prompt; returns the request id. The request is
-        admitted into a cache slot (prefilled) by a later `step` when
-        a slot is free."""
+        admitted into a cache slot by a later `step` when a slot is
+        free; its prompt then streams through the prefill budget. The
+        only length bound is the physical cache: a prompt must fit in
+        ``capacity`` rows. (The legacy whole-prompt path additionally
+        needs the prompt to fit its ``max_prompt_len`` pad width.)"""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
-        if len(prompt) > self.max_prompt_len:
+        if len(prompt) > self.capacity:
             raise ValueError(
-                f"prompt length {len(prompt)} exceeds max_prompt_len "
-                f"{self.max_prompt_len} (chunked prefill is a future PR)"
+                f"prompt length {len(prompt)} exceeds the cache "
+                f"capacity {self.capacity} (rows per slot)"
+            )
+        if not self.chunked and len(prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the whole-prompt "
+                f"pad width max_prompt_len={self.max_prompt_len}; the "
+                f"default chunked engine (prefill_token_budget) "
+                f"streams prompts of any length"
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if request_id is None:
             request_id = self._next_id
         self._next_id = max(self._next_id, request_id) + 1
-        self._queue.append(Request(request_id, prompt, max_new_tokens))
+        self._queue.append(
+            Request(
+                request_id, prompt, max_new_tokens,
+                enqueued_at=time.perf_counter(),
+            )
+        )
         return request_id
 
     def step(self) -> List[GenerationResult]:
-        """One engine tick: admit queued requests into free slots
-        (one compiled prefill each), then ONE compiled decode step for
-        the whole slot grid. Returns the requests that finished this
-        tick (their slots are already free for the next)."""
-        finished: List[GenerationResult] = []
+        """One engine tick. Chunked mode: admit queued requests into
+        free slots (bookkeeping only), pack up to the token budget of
+        pending prompt tokens, and run ONE compiled mixed
+        chunk+decode step (decode-only fast path when nothing is
+        prefilling). Legacy mode: one compiled whole-prompt prefill
+        per admit, then the decode step. Returns the requests that
+        finished this tick (their slots are already free for the
+        next)."""
+        if self.chunked:
+            return self._step_chunked()
+        return self._step_whole()
 
-        # ---- admit ----------------------------------------------------
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+    ) -> List[GenerationResult]:
+        """Convenience batch API: queue every prompt, run the serving
+        loop dry, return results in prompt order."""
+        ids = [self.add_request(p, max_new_tokens) for p in prompts]
+        done = {}
+        while self.has_work():
+            for r in self.step():
+                done[r.request_id] = r
+        return [done[i] for i in ids]
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _admit_free_slots(self, now: float) -> None:
+        """Lease free slots to queued requests (host bookkeeping; the
+        prefill work itself is scheduled by the caller)."""
         for slot in range(self.num_slots):
             if self._slots[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
+            self._admitted += 1
+            self._queue_waits.append(now - req.enqueued_at)
+            self._slots[slot] = _Slot(
+                req=req, generated=[], pos=0, cursor=0
+            )
+
+    def _step_chunked(self) -> List[GenerationResult]:
+        finished: List[GenerationResult] = []
+        now = time.perf_counter()
+        self._admit_free_slots(now)
+
+        budget = self.prefill_token_budget
+        S = self.num_slots
+        chunk_tokens = np.zeros((budget,), np.int32)
+        # slot id == num_slots marks padding: the scatter drops it and
+        # the segment mask keeps pads talking only to each other
+        chunk_slots = np.full((budget,), S, np.int32)
+        chunk_pos = np.zeros((budget,), np.int32)
+        lengths_before = np.zeros((S,), np.int32)
+        lengths_after = np.zeros((S,), np.int32)
+        completions = []  # (slot, chunk index of the last prompt token)
+        used = 0
+        # slot order keeps the packed segment ids non-decreasing (the
+        # varlen kernel's contract)
+        for slot in range(S):
+            st = self._slots[slot]
+            if st is not None:
+                lengths_before[slot] = st.pos
+                lengths_after[slot] = st.pos
+            if st is None or not st.prefilling or used >= budget:
+                continue
+            n = min(budget - used, len(st.req.prompt) - st.cursor)
+            if self.prefill_chunk is not None:
+                n = min(n, self.prefill_chunk)
+            chunk_tokens[used:used + n] = st.req.prompt[
+                st.cursor:st.cursor + n
+            ]
+            chunk_slots[used:used + n] = slot
+            chunk_pos[used:used + n] = np.arange(
+                st.cursor, st.cursor + n
+            )
+            st.cursor += n
+            st.pos = st.cursor
+            lengths_after[slot] = st.cursor
+            self._prompt_tokens += n
+            if not st.prefilling:
+                completions.append((slot, used + n - 1))
+            used += n
+
+        # decode grid: slots whose prompt completed in an EARLIER tick
+        # (a slot finishing prefill this tick gets its first token from
+        # the chunk logits below and starts decoding next tick)
+        active = np.array(
+            [s is not None and bool(s.generated) for s in self._slots],
+            dtype=bool,
+        )
+        dec_tokens = np.array(
+            [s.generated[-1] if s is not None and s.generated else 0
+             for s in self._slots],
+            np.int32,
+        )
+
+        completion_idx = np.full((S,), -1, np.int32)
+        for slot, idx in completions:
+            completion_idx[slot] = idx
+
+        chunk_out = None
+        dec_out = None
+        if used > 0:
+            self._rng, rng = jax.random.split(self._rng)
+            t0 = time.perf_counter()
+            with profiler.annotate(
+                "inference/mixed_step",
+                chunk_tokens=used, decodes=int(active.sum()),
+            ):
+                chunk_tok, dec_tok, self.cache = self._mixed_jit(
+                    self.params, self.cache,
+                    jnp.asarray(chunk_tokens), jnp.asarray(chunk_slots),
+                    jnp.asarray(chunk_pos), jnp.asarray(lengths_before),
+                    jnp.asarray(lengths_after),
+                    jnp.asarray(completion_idx), jnp.asarray(dec_tokens),
+                    jnp.asarray(active), rng,
+                )
+            # ONE batched value fetch per tick (= the device sync) —
+            # never a per-request scalar pull
+            chunk_out, dec_out = jax.device_get((chunk_tok, dec_tok))
+            self._prefill_seconds += time.perf_counter() - t0
+            self._mixed_steps += 1
+            if active.any() or completions:
+                self._decode_steps += 1
+        elif active.any():
+            self._rng, rng = jax.random.split(self._rng)
+            t0 = time.perf_counter()
+            with profiler.annotate(
+                "inference/decode", batch=int(active.sum())
+            ):
+                tok, self.cache = self._decode_jit(
+                    self.params, self.cache, jnp.asarray(dec_tokens),
+                    jnp.asarray(active), rng,
+                )
+            dec_out = np.asarray(tok)  # value fetch = device sync
+            self._decode_seconds += time.perf_counter() - t0
+            self._decode_steps += 1
+
+        now2 = time.perf_counter()
+        for slot, idx in completions:
+            st = self._slots[slot]
+            st.generated.append(int(chunk_out[idx]))
+            self._generated_tokens += 1
+            self._ttfts.append(now2 - st.req.enqueued_at)
+            done = self._finish_reason(st)
+            if done is not None:
+                # the fused decode already ran for this slot; its
+                # output is discarded with the eviction (dead-row junk)
+                finished.append(self._evict(slot, st, done))
+                continue
+            # the mixed step fed the first token straight into the
+            # decode grid: the SECOND token arrives in the same tick
+            # (the whole-prompt admit-tick cadence, without the pad)
+            st.pos += 1
+            st.generated.append(int(dec_out[slot]))
+            self._generated_tokens += 1
+            done = self._finish_reason(st)
+            if done is not None:
+                finished.append(self._evict(slot, st, done))
+        if dec_out is not None:
+            for slot, st in enumerate(self._slots):
+                if st is None or not active[slot]:
+                    continue
+                st.pos += 1  # the input token was written this step
+                st.generated.append(int(dec_out[slot]))
+                self._generated_tokens += 1
+                done = self._finish_reason(st)
+                if done is not None:
+                    finished.append(self._evict(slot, st, done))
+        return finished
+
+    def _step_whole(self) -> List[GenerationResult]:
+        """Legacy whole-prompt prefill (the A/B baseline): one padded
+        compiled prefill per admitted request — every other slot's
+        decode WAITS on it (the head-of-line blocking the chunked
+        scheduler removes) — then one decode step for the grid."""
+        finished: List[GenerationResult] = []
+        t_admit = time.perf_counter()
+        pending = []  # (slot, device first-token)
+        for slot in range(self.num_slots):
+            if self._slots[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            self._queue_waits.append(t_admit - req.enqueued_at)
             toks = np.zeros((1, self.max_prompt_len), np.int32)
             toks[0, : len(req.prompt)] = req.prompt
             self._rng, rng = jax.random.split(self._rng)
-            t0 = time.perf_counter()
             with profiler.annotate(
                 "inference/prefill", slot=slot, prompt_len=len(req.prompt)
             ):
@@ -325,19 +665,28 @@ class InferenceEngine:
                     self.params, self.cache, jnp.asarray(toks),
                     slot, len(req.prompt), rng,
                 )
-            first_tok = int(tok)  # value fetch = device sync
-            self._prefill_seconds += time.perf_counter() - t0
             self._admitted += 1
             self._prompt_tokens += len(req.prompt)
-            self._generated_tokens += 1
-            state = _Slot(
-                req=req, generated=[first_tok], pos=len(req.prompt)
+            self._slots[slot] = _Slot(
+                req=req, generated=[], pos=len(req.prompt),
+                cursor=len(req.prompt),
             )
-            done = self._finish_reason(state)
-            if done is not None:
-                finished.append(self._evict(slot, state, done))
-            else:
-                self._slots[slot] = state
+            pending.append((slot, tok))
+        if pending:
+            # ONE batched value fetch for every admit this tick (the
+            # device sync) — the per-request int(tok) pull serialized
+            # host and device once per admitted request
+            first_toks = jax.device_get([t for _, t in pending])
+            now = time.perf_counter()
+            self._prefill_seconds += now - t_admit
+            for (slot, _), tok in zip(pending, first_toks):
+                st = self._slots[slot]
+                st.generated.append(int(tok))
+                self._generated_tokens += 1
+                self._ttfts.append(now - st.req.enqueued_at)
+                done = self._finish_reason(st)
+                if done is not None:
+                    finished.append(self._evict(slot, st, done))
 
         # ---- decode ---------------------------------------------------
         active = np.array(
@@ -371,24 +720,6 @@ class InferenceEngine:
                 if done is not None:
                     finished.append(self._evict(slot, state, done))
         return finished
-
-    def generate(
-        self,
-        prompts: Sequence[Sequence[int]],
-        max_new_tokens: int,
-    ) -> List[GenerationResult]:
-        """Convenience batch API: queue every prompt, run the serving
-        loop dry, return results in prompt order."""
-        ids = [self.add_request(p, max_new_tokens) for p in prompts]
-        done = {}
-        while self.has_work():
-            for r in self.step():
-                done[r.request_id] = r
-        return [done[i] for i in ids]
-
-    # ------------------------------------------------------------------
-    # internals
-    # ------------------------------------------------------------------
 
     def _finish_reason(self, state: _Slot) -> Optional[str]:
         if (

@@ -130,6 +130,35 @@ class KVCache:
         v[layer] = jax.vmap(_row)(self.v[layer], v_new, self.lengths)
         return self.replace(k=tuple(k), v=tuple(v))
 
+    def write_at(
+        self,
+        layer: int,
+        slots: jnp.ndarray,
+        positions: jnp.ndarray,
+        k_new: jnp.ndarray,
+        v_new: jnp.ndarray,
+    ) -> "KVCache":
+        """Scatter a PACKED token chunk into ``layer`` at explicit
+        per-token ``(slot, position)`` destinations — the chunked-
+        prefill write: one tick's budget of prompt tokens lands at each
+        slot's prefill cursor in one scatter, no per-request dispatch.
+
+        ``slots``/``positions``: (budget,) int32; ``k_new``/``v_new``:
+        (budget, heads, head_dim). Padding tokens carry an
+        out-of-range slot id (>= num_slots) and are DROPPED by the
+        scatter (``mode="drop"``), so a partially filled chunk never
+        touches live rows. Does not advance ``lengths`` — the engine
+        commits cursors once per tick."""
+        k = list(self.k)
+        v = list(self.v)
+        k[layer] = self.k[layer].at[slots, positions].set(
+            k_new.astype(self.k[layer].dtype), mode="drop"
+        )
+        v[layer] = self.v[layer].at[slots, positions].set(
+            v_new.astype(self.v[layer].dtype), mode="drop"
+        )
+        return self.replace(k=tuple(k), v=tuple(v))
+
     def advance(self, t: int, active: Optional[jnp.ndarray] = None
                 ) -> "KVCache":
         """Advance lengths by ``t`` (clamped to capacity; the engine

@@ -354,6 +354,7 @@ class ParallelAttention(nn.Module):
         attention_mask=None,
         deterministic: bool = True,
         cache=None,
+        chunk=None,
     ):
         cfg = self.cfg
         tp = cfg.tensor_parallel_size or (
@@ -481,7 +482,123 @@ class ParallelAttention(nn.Module):
             return jax.random.randint(rng, (), 0, 2**31 - 1, jnp.int32)
 
         new_kv = None
-        if cache is not None:
+        if cache is not None and chunk is not None:
+            # ---- chunked prefill: one PACKED token chunk, one or more
+            # slots, attending each slot's existing cache prefix plus
+            # intra-chunk causality. `chunk` = (slot_ids, positions),
+            # both (budget,) int32; x is the (1, budget, h) packed
+            # stream; padding tokens carry slot id == num_slots.
+            if x.shape[0] != 1:
+                raise ValueError(
+                    "chunked prefill takes one packed stream "
+                    f"(batch 1), got batch {x.shape[0]}"
+                )
+            k_buf, v_buf, lengths = cache
+            chunk_slots, chunk_pos = chunk
+            num_slots, capacity = k_buf.shape[0], k_buf.shape[1]
+            budget = x.shape[1]
+            q, k, v = jnp.split(qkv, 3, axis=-1)  # (1, budget, nh, hd)
+            qq, kq, vq = q[0], k[0], v[0]  # (budget, nh, hd)
+            # scatter this chunk's K/V at per-token (slot, position)
+            # destinations (in place under jit with donated buffers);
+            # out-of-range pad slots are dropped by the scatter
+            k_buf = k_buf.at[chunk_slots, chunk_pos].set(
+                kq.astype(k_buf.dtype), mode="drop"
+            )
+            v_buf = v_buf.at[chunk_slots, chunk_pos].set(
+                vq.astype(v_buf.dtype), mode="drop"
+            )
+            new_kv = (k_buf, v_buf)
+            slot_c = jnp.clip(chunk_slots, 0, num_slots - 1)
+            if cfg.attention_impl == "jnp":
+                # one-pass reference: the chunk K/V are already in the
+                # cache (scatter above), so each token attends its
+                # slot's rows [0, pos + 1) — prefix, intra-chunk
+                # predecessors, and itself in one bounded softmax. The
+                # slot selection rides a one-hot contraction instead of
+                # a per-token gather: k_buf[slots] would materialize
+                # (budget, capacity, heads, hd) — each slot's cache
+                # duplicated once per chunk token (measured as most of
+                # the mixed-tick cost on the CPU serve bench)
+                onehot = (
+                    slot_c[:, None] == jnp.arange(num_slots)[None, :]
+                ).astype(jnp.float32)  # (budget, num_slots)
+                scores = jnp.einsum(
+                    "tnd,scnd,ts->tnc",
+                    qq.astype(jnp.float32),
+                    k_buf.astype(jnp.float32),
+                    onehot,
+                ) * scale
+                col = jnp.arange(capacity)[None, None, :]
+                bound = (chunk_pos + 1)[:, None, None]
+                scores = jnp.where(col < bound, scores, -jnp.inf)
+                probs = jax.nn.softmax(scores, axis=-1)
+                ctx_t = jnp.einsum(
+                    "tnc,scnd,ts->tnd",
+                    probs,
+                    v_buf.astype(jnp.float32),
+                    onehot,
+                )
+            else:
+                # flash: two pieces merged by log-sum-exp weights.
+                # (A) intra-chunk causal attention over the packed
+                # stream, segment-masked by slot id (the packed varlen
+                # kernel — pads only match each other);
+                from rocm_apex_tpu.ops.flash_attention import (
+                    flash_attention_decode,
+                )
+                from rocm_apex_tpu.ops.flash_attention_segments import (
+                    flash_attention_segments_with_lse,
+                )
+
+                qT = qq.transpose(1, 0, 2)  # (nh, budget, hd)
+                o_a, lse_a = flash_attention_segments_with_lse(
+                    qT,
+                    kq.transpose(1, 0, 2),
+                    vq.transpose(1, 0, 2),
+                    chunk_slots,
+                    causal=True,
+                    scale=scale,
+                )
+                # (B) the whole chunk against every slot's PRE-CHUNK
+                # cache prefix — the cache is read once at slot
+                # granularity (chunk width, not per-token width), with
+                # each slot's bound = its materialized length; rows
+                # with an empty prefix merge in at weight zero
+                kc = (
+                    k_buf.transpose(0, 2, 1, 3)
+                    .reshape(num_slots * nh_local, capacity, hd)
+                )
+                vc = (
+                    v_buf.transpose(0, 2, 1, 3)
+                    .reshape(num_slots * nh_local, capacity, hd)
+                )
+                qB = jnp.broadcast_to(
+                    qT[None], (num_slots, nh_local, budget, hd)
+                ).reshape(num_slots * nh_local, budget, hd)
+                o_b, lse_b = flash_attention_decode(
+                    qB, kc, vc,
+                    jnp.repeat(lengths, nh_local),
+                    scale, return_lse=True,
+                )
+                o_b = o_b.reshape(num_slots, nh_local, budget, hd)
+                lse_b = lse_b.reshape(num_slots, nh_local, budget)
+                tok = jnp.arange(budget)
+                o_b = o_b[slot_c, :, tok]  # (budget, nh, hd)
+                lse_b = lse_b[slot_c, :, tok]  # (budget, nh)
+                o_a = o_a.transpose(1, 0, 2)  # (budget, nh, hd)
+                lse_a = lse_a.transpose(1, 0)  # (budget, nh)
+                m = jnp.maximum(lse_a, lse_b)
+                w_a = jnp.exp(lse_a - m)
+                w_b = jnp.exp(lse_b - m)
+                ctx_t = (
+                    w_a[..., None] * o_a.astype(jnp.float32)
+                    + w_b[..., None] * o_b.astype(jnp.float32)
+                ) / (w_a + w_b)[..., None]
+            ctx = ctx_t.astype(cfg.dtype).reshape(
+                1, budget, nh_local * hd
+            )
+        elif cache is not None:
             k_buf, v_buf, lengths = cache
             q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
             # write the new keys/values at each slot's current length
@@ -741,6 +858,7 @@ class ParallelTransformerLayer(nn.Module):
         delta=None,
         chain: bool = False,
         cache=None,
+        chunk=None,
     ):
         cfg = self.cfg
         if (delta is not None or chain) and (
@@ -777,7 +895,7 @@ class ParallelTransformerLayer(nn.Module):
             # inside the LN kernel
             ln1, x = ln1_mod(delta.astype(x.dtype), residual=x)
         attn = ParallelAttention(cfg, self.attn_mask_type, name="self_attention")(
-            ln1, attention_mask, deterministic, cache
+            ln1, attention_mask, deterministic, cache, chunk
         )
         new_kv = None
         if cache is not None:
@@ -843,6 +961,7 @@ class ParallelTransformer(nn.Module):
         attention_mask=None,
         deterministic: bool = True,
         cache=None,
+        chunk=None,
     ):
         n = self.num_layers or self.cfg.num_layers
         layer_cls = ParallelTransformerLayer
@@ -875,7 +994,7 @@ class ParallelTransformer(nn.Module):
                     self.cfg, self.attn_mask_type, name=f"layer_{i}"
                 )(
                     x, attention_mask, deterministic, None, False,
-                    (cache.k[i], cache.v[i], cache.lengths),
+                    (cache.k[i], cache.v[i], cache.lengths), chunk,
                 )
                 new_k.append(k_i)
                 new_v.append(v_i)
@@ -918,6 +1037,12 @@ class ParallelTransformer(nn.Module):
             x = x + delta.astype(x.dtype)
         x = x.astype(self.cfg.dtype)
         if cache is not None:
+            if chunk is not None:
+                # chunked prefill: tokens landed at explicit per-slot
+                # offsets, a variable number per slot — the ENGINE
+                # commits the new cursors once per tick (lengths are
+                # untouched here)
+                return x, cache.replace(k=tuple(new_k), v=tuple(new_v))
             # every layer wrote at the same offsets; advance ONCE, for
             # all slots (the engine masks inactive slots afterwards)
             return x, cache.replace(
@@ -1028,9 +1153,19 @@ class GPTModel(nn.Module):
     never imports the inference package) and the call returns
     ``(logits, updated_cache)``. Position ids default to each slot's
     current length; ``tokens`` of width 1 run the single-token decode
-    kernel against the cache, wider windows are prefill (slots must
-    start at length 0). The caller masks which slots' length advances
-    (see inference/engine.py).
+    kernel against the cache, wider windows are whole-prompt prefill
+    (slots must start at length 0). The caller masks which slots'
+    length advances (see inference/engine.py).
+
+    ``chunk=(slot_ids, positions)`` selects CHUNKED prefill instead:
+    ``tokens`` is a ``(1, budget)`` packed stream mixing pieces of one
+    or more prompts; each layer scatters the chunk's K/V at per-token
+    ``(slot, position)`` cache destinations and every token attends
+    its slot's rows ``[0, pos + 1)`` (cache prefix + intra-chunk
+    causality — the segments kernel merged with a chunk-width bounded
+    cache read on the flash path). ``lengths`` are NOT advanced (the
+    serving engine commits cursors once per tick); padding tokens
+    carry slot id == num_slots. See docs/inference.md.
     """
 
     cfg: GPTConfig
@@ -1048,8 +1183,14 @@ class GPTModel(nn.Module):
         loss_mask=None,
         deterministic: bool = True,
         cache=None,
+        chunk=None,
         loss_reduction: Optional[str] = None,
     ):
+        if chunk is not None and cache is None:
+            raise ValueError(
+                "chunked prefill writes into a KV cache; pass cache= "
+                "alongside chunk="
+            )
         if cache is not None:
             if labels is not None:
                 raise ValueError(
@@ -1062,14 +1203,20 @@ class GPTModel(nn.Module):
                     "inference (the cache holds full sequences)"
                 )
             if position_ids is None:
-                # each slot's window continues at its own length
-                position_ids = (
-                    cache.lengths[:, None]
-                    + jnp.arange(tokens.shape[1])[None, :]
-                )
+                if chunk is not None:
+                    # packed chunk: every token carries its own
+                    # absolute position (its slot's prefill cursor +
+                    # offset within the chunk)
+                    position_ids = chunk[1][None, :]
+                else:
+                    # each slot's window continues at its own length
+                    position_ids = (
+                        cache.lengths[:, None]
+                        + jnp.arange(tokens.shape[1])[None, :]
+                    )
             x = self.embedding(tokens, position_ids, deterministic)
             x, cache = self.transformer(
-                x, deterministic=deterministic, cache=cache
+                x, deterministic=deterministic, cache=cache, chunk=chunk
             )
             return self.embedding.attend(x), cache
         x = self.embedding(tokens, position_ids, deterministic)

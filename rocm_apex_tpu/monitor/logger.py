@@ -142,7 +142,14 @@ class MetricsLogger:
         flops_per_step: Optional[float] = None,
         n_chips: int = 1,
         peak_flops: Optional[float] = None,
-        last_value: Iterable[str] = ("overflows",),
+        last_value: Iterable[str] = (
+            # the scaler's monotonic overflow counter, plus the
+            # serving engine's monotonic counters (`InferenceEngine.
+            # stats()`): all flush as last value, never a window mean
+            "overflows",
+            "admitted", "evicted", "prompt_tokens",
+            "generated_tokens", "decode_steps", "mixed_steps",
+        ),
         timers: Optional[Timers] = None,
         memory_stats: bool = True,
     ):

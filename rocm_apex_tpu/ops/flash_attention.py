@@ -810,13 +810,21 @@ DECODE_BLOCK_T = 16
 
 
 def _decode_kernel(
-    scale, sk_real, block_t, block_k,
-    q_ref, k_ref, v_ref, len_ref, o_ref, m_scr, l_scr, acc_scr,
+    scale, sk_real, block_t, block_k, has_lse,
+    q_ref, k_ref, v_ref, len_ref, o_ref, *rest,
 ):
     """Online-softmax decode step for grid point (b, ki). Mirrors
     `_fwd_kernel`'s accumulation exactly (same `_masked_scores`, same
     base-2 domain) minus everything decode never needs: causal
-    masking, bias, dropout, lse output, and the backward."""
+    masking, bias, dropout, and the backward. ``has_lse`` adds the
+    natural-log lse output the chunked-prefill merge consumes
+    (models/gpt.py combines the prefix piece with the intra-chunk
+    piece by log-sum-exp weights)."""
+    if has_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -858,6 +866,14 @@ def _decode_kernel(
         l = l_scr[:, :1]
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        if has_lse:
+            # rows with an empty live prefix carry lse = -inf-tier so a
+            # downstream log-sum-exp merge weighs them to exactly zero
+            lse_ref[0] = jnp.where(
+                l > 0.0,
+                (m_scr[:, :1] + jnp.log2(safe_l)) * LN2,
+                NEG_INF,
+            )
 
 
 def flash_attention_decode(
@@ -867,37 +883,38 @@ def flash_attention_decode(
     kv_lengths: jnp.ndarray,
     scale: Optional[float] = None,
     block_k: int = DEFAULT_BLOCK_K,
-) -> jnp.ndarray:
-    """Single-token decode attention against a preallocated KV cache.
+    return_lse: bool = False,
+):
+    """Decode/chunk attention against a preallocated KV cache.
 
-    ``q`` is (batch*heads, t, head_dim) with t == 1 (the token being
-    decoded); ``k``/``v`` are (batch*heads, capacity, head_dim) cache
-    buffers whose live prefix per row is ``kv_lengths`` (int32,
-    INCLUDING the just-written token — row b attends keys
-    ``[0, kv_lengths[b])``; rows with length 0 emit zeros). Forward
-    only — inference never differentiates — so no lse is saved and no
-    vjp is defined. The q block is one 16-row tile instead of the
+    ``q`` is (batch*heads, t, head_dim) — t == 1 is the single-token
+    decode step; t > 1 is the chunked-prefill read, where every query
+    row of a batch row shares that row's bound (the slot's prefix).
+    ``k``/``v`` are (batch*heads, capacity, head_dim) cache buffers
+    whose live prefix per row is ``kv_lengths`` (int32 — row b attends
+    keys ``[0, kv_lengths[b])``; rows with length 0 emit zeros, and
+    lse = -inf-tier so a log-sum-exp merge drops them). Forward only —
+    inference never differentiates — so no vjp is defined.
+    ``return_lse`` returns ``(o, lse)`` with lse (batch*heads, t) in
+    natural log, the merge operand for combining this prefix piece
+    with an intra-chunk piece (`flash_attention_segments_with_lse`).
+    The q block is one tile of ``round_up(t, 16)`` rows instead of the
     general kernel's 128, and key blocks past a row's live prefix skip
     their MXU work entirely.
     """
     bh, t, d0 = q.shape
-    if t != 1:
-        raise ValueError(
-            f"flash_attention_decode takes one query token per row "
-            f"(got t={t}); prefill goes through flash_attention"
-        )
     sk = k.shape[1]
     s = scale if scale is not None else 1.0 / np.sqrt(d0)
     d = _round_up(d0, 128)
-    block_t = DECODE_BLOCK_T
+    block_t = _round_up(t, DECODE_BLOCK_T)
     block_k = min(block_k, _round_up(sk, 128))
     sk_p = _round_up(sk, block_k)
     qp = jnp.pad(q, ((0, 0), (0, block_t - t), (0, d - d0)))
     kp = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d - d0)))
     vp = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d - d0)))
 
-    o = pallas_call(
-        functools.partial(_decode_kernel, s, sk, block_t, block_k),
+    o, lse = pallas_call(
+        functools.partial(_decode_kernel, s, sk, block_t, block_k, True),
         grid=(bh, sk_p // block_k),
         in_specs=[
             pl.BlockSpec((1, block_t, d), lambda b, j: (b, 0, 0)),
@@ -905,14 +922,22 @@ def flash_attention_decode(
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_t, d), lambda b, j: (b, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, block_t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_t, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_t, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, block_t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, block_t, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_t, 128), jnp.float32),
             pltpu.VMEM((block_t, 128), jnp.float32),
             pltpu.VMEM((block_t, d), jnp.float32),
         ],
     )(qp, kp, vp, jnp.asarray(kv_lengths, jnp.int32))
+    if return_lse:
+        return o[:, :t, :d0], lse[:, :t, 0]
     return o[:, :t, :d0]
 
 

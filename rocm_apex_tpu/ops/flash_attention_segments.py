@@ -49,7 +49,10 @@ from rocm_apex_tpu.ops.flash_attention import (
     _round_up,
 )
 
-__all__ = ["flash_attention_segments"]
+__all__ = [
+    "flash_attention_segments",
+    "flash_attention_segments_with_lse",
+]
 
 DEFAULT_BLOCK = 512
 
@@ -364,6 +367,32 @@ def flash_attention_segments(
         block_q, block_k,
     )
     return o
+
+
+def flash_attention_segments_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+):
+    """Forward-only packed attention returning ``(o, lse)``.
+
+    Same masking contract as `flash_attention_segments`; ``lse`` is
+    (heads, total) in natural log — the merge operand the
+    chunked-prefill path needs to combine this INTRA-CHUNK piece with
+    the per-slot cache-prefix piece
+    (`flash_attention_decode(..., return_lse=True)`) by log-sum-exp
+    weights. No vjp: inference never differentiates this variant.
+    """
+    return _seg_fwd(
+        q, k, v, segment_ids, causal,
+        scale if scale is not None else 1.0 / np.sqrt(q.shape[-1]),
+        block_q, block_k,
+    )
 
 
 def _fas_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k):

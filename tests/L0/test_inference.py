@@ -1,11 +1,20 @@
 """Inference tier: KV cache correctness, sampling, continuous batching.
 
-The contract under test is the ISSUE-1 acceptance bar: prefill+decode
-through the preallocated cache must reproduce the full-sequence forward
-logits at fp32 tolerance on CPU, sampling must replay under a fixed
-seed, slot eviction/reuse must not pollute a successor request, and
-the engine's compiled ``decode_step`` must trace exactly once while
-serving mixed-length traffic with mid-stream admits and evictions.
+The contract under test is the ISSUE-1 acceptance bar plus the ISSUE-5
+chunked-prefill bar: prefill+decode through the preallocated cache must
+reproduce the full-sequence forward logits at fp32 tolerance on CPU,
+sampling must replay under a fixed seed, slot eviction/reuse must not
+pollute a successor request, the engine's compiled programs must trace
+exactly once while serving mixed-length traffic with mid-stream admits
+and evictions, and the token-budget chunked scheduler must be greedy-
+token-identical to the whole-prompt path while (a) serving prompts
+longer than any whole-prompt pad width, (b) decoding every tick while
+a long prefill streams, and (c) never materializing a full-prompt-width
+activation in the mixed step (`monitor.audit.assert_no_intermediate`).
+
+Every engine in this file shares ONE shape tuple (slots=2, capacity=24,
+budget=4, the fp32_cfg model) so the persistent compile cache pays each
+program once — the tier-1 wall-time contract (tools/tier1_budget.json).
 """
 
 import jax
@@ -106,6 +115,28 @@ class TestKVCache:
         cache = cache.replace(lengths=jnp.array([3, 2], jnp.int32))
         cache = cache.reset_slot(0)
         assert np.array_equal(np.asarray(cache.lengths), [0, 2])
+
+    def test_write_at_scatters_chunk_and_drops_pads(self):
+        """The chunked-prefill write: one packed chunk lands at per-
+        token (slot, position) destinations in one scatter; padding
+        tokens carry slot id == num_slots and must not touch any row."""
+        cache = KVCache.create(1, 2, 8, 1, 4, dtype=jnp.float32)
+        slots = jnp.array([0, 0, 1, 2], jnp.int32)  # last is padding
+        pos = jnp.array([2, 3, 5, 0], jnp.int32)
+        new = jnp.arange(1, 5, dtype=jnp.float32)[
+            :, None, None
+        ] * jnp.ones((4, 1, 4), jnp.float32)
+        cache = cache.write_at(0, slots, pos, new, new * 10.0)
+        k = np.asarray(cache.k[0])
+        v = np.asarray(cache.v[0])
+        assert np.all(k[0, 2] == 1.0) and np.all(k[0, 3] == 2.0)
+        assert np.all(k[1, 5] == 3.0) and np.all(v[1, 5] == 30.0)
+        # pad token (slot 2 of 2) dropped; everything else untouched
+        written = np.zeros((2, 8), bool)
+        written[0, 2] = written[0, 3] = written[1, 5] = True
+        assert np.all(k[~written] == 0.0)
+        # lengths are NOT advanced (the engine commits cursors)
+        assert np.array_equal(np.asarray(cache.lengths), [0, 0])
 
 
 # ---------------------------------------------------------------------------
@@ -260,11 +291,21 @@ class TestSampling:
 
 
 def greedy_engine(model, params, **kw):
+    """Chunked-prefill greedy engine — ONE shape tuple for the whole
+    file (slots=2, capacity=24, budget=4) so every test hits the same
+    compiled mixed/decode programs."""
     kw.setdefault("num_slots", 2)
-    kw.setdefault("max_prompt_len", 8)
     kw.setdefault("capacity", 24)
+    kw.setdefault("prefill_token_budget", 4)
     kw.setdefault("sampling", SamplingParams(temperature=0.0))
     return InferenceEngine(model, params, **kw)
+
+
+def whole_engine(model, params, **kw):
+    """The legacy whole-prompt A/B baseline (pad width 24)."""
+    kw.setdefault("prefill_token_budget", None)
+    kw.setdefault("max_prompt_len", 24)
+    return greedy_engine(model, params, **kw)
 
 
 class TestEngine:
@@ -290,9 +331,11 @@ class TestEngine:
             )[0]
             assert solo.tokens == batched[i].tokens, f"request {i} polluted"
 
-    def test_decode_compiles_exactly_once(self):
+    def test_mixed_step_compiles_exactly_once(self):
         """Mixed prompt lengths, a mid-stream admit, and evictions must
-        all reuse ONE compiled decode program (and one prefill)."""
+        all reuse ONE compiled mixed chunk+decode program (and at most
+        one decode-only fast-path program) — the fixed-shape contract:
+        the prompt mix never retraces."""
         cfg = fp32_cfg()
         model, params = make_model(cfg)
         eng = greedy_engine(model, params)
@@ -306,8 +349,23 @@ class TestEngine:
         while eng.has_work():
             done += eng.step()
         assert len(done) == 3
-        assert eng.decode_trace_count == 1
+        assert eng.mixed_trace_count == 1
+        assert eng.decode_trace_count <= 1
+        assert eng.prefill_trace_count == 0  # whole-prompt path unused
+
+    def test_whole_prompt_engine_compiles_exactly_once(self):
+        """The legacy A/B path keeps its own invariant: one compiled
+        prefill, one compiled decode."""
+        cfg = fp32_cfg()
+        model, params = make_model(cfg)
+        eng = whole_engine(model, params)
+        eng.add_request([1, 2, 3, 4, 5], max_new_tokens=3)
+        eng.add_request([6], max_new_tokens=2)
+        while eng.has_work():
+            eng.step()
         assert eng.prefill_trace_count == 1
+        assert eng.decode_trace_count == 1
+        assert eng.mixed_trace_count == 0
 
     def test_eos_finishes_request(self):
         cfg = fp32_cfg()
@@ -330,7 +388,7 @@ class TestEngine:
     def test_capacity_forces_eviction(self):
         cfg = fp32_cfg()
         model, params = make_model(cfg)
-        eng = greedy_engine(model, params, capacity=8, max_prompt_len=6)
+        eng = greedy_engine(model, params, capacity=8)
         r = eng.generate([[1, 2, 3, 4, 5, 6]], max_new_tokens=20)[0]
         # 6 prompt tokens + generated tokens may occupy at most 8 cache
         # rows; the engine must stop BEFORE any clamped write
@@ -343,10 +401,20 @@ class TestEngine:
         eng = greedy_engine(model, params)
         with pytest.raises(ValueError, match="non-empty"):
             eng.add_request([], 4)
-        with pytest.raises(ValueError, match="max_prompt_len"):
-            eng.add_request(list(range(9)), 4)
+        # the chunked engine has NO prompt-length ceiling below the
+        # physical cache: only a prompt that cannot fit capacity rows
+        # is rejected (the old max_prompt_len admit error is gone)
+        eng.add_request(list(range(eng.capacity)), 4)
+        with pytest.raises(ValueError, match="capacity"):
+            eng.add_request(list(range(eng.capacity + 1)), 4)
         with pytest.raises(ValueError, match="max_new_tokens"):
             eng.add_request([1], 0)
+        # legacy whole-prompt path: the pad width is a real bound
+        weng = whole_engine(model, params, max_prompt_len=8)
+        with pytest.raises(ValueError, match="pad width"):
+            weng.add_request(list(range(9)), 4)
+        with pytest.raises(ValueError, match="prefill_token_budget"):
+            greedy_engine(model, params, prefill_token_budget=0)
         with pytest.raises(NotImplementedError, match="tp"):
             InferenceEngine(
                 GPTModel(fp32_cfg(tensor_parallel_size=2)), params
@@ -369,3 +437,132 @@ class TestEngine:
             ]
 
         assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# chunked-prefill token-budget scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestChunkedPrefill:
+    def test_greedy_parity_with_whole_prompt_engine(self):
+        """The chunked scheduler must be TOKEN-IDENTICAL to the
+        whole-prompt baseline under greedy sampling: chunk sizes that
+        do (8 = 2*4) and do not (3, 5, 18) divide the budget, plus a
+        prompt LONGER than any whole-prompt pad width the old engine
+        ever allowed in this file (18 > 8) — it streams through in
+        budget-sized pieces and completes."""
+        cfg = fp32_cfg()
+        model, params = make_model(cfg)
+        prompts = [
+            [1, 2, 3],
+            [4, 5, 6, 7, 8],
+            list(range(10, 18)),
+            list(range(30, 48)),  # 18 tokens: 4+4+4+4+2 chunks
+        ]
+        chunked = greedy_engine(model, params).generate(
+            prompts, max_new_tokens=4
+        )
+        whole = whole_engine(model, params).generate(
+            prompts, max_new_tokens=4
+        )
+        for c, w in zip(chunked, whole):
+            assert c.tokens == w.tokens, c.request_id
+            assert c.finish_reason == "length"
+            assert len(c.tokens) == 4
+
+    def test_prefill_chunk_caps_per_request_share(self):
+        """`prefill_chunk` (the per-request fairness knob inside the
+        budget) must not change the tokens, only the schedule."""
+        cfg = fp32_cfg()
+        model, params = make_model(cfg)
+        prompts = [[1, 2, 3, 4, 5, 6, 7], [8, 9, 10]]
+        base = greedy_engine(model, params).generate(
+            prompts, max_new_tokens=3
+        )
+        capped = greedy_engine(model, params, prefill_chunk=2).generate(
+            prompts, max_new_tokens=3
+        )
+        assert [r.tokens for r in base] == [r.tokens for r in capped]
+
+    def test_decode_liveness_while_long_prefill_streams(self):
+        """Head-of-line blocking is gone: while an 16-token prompt
+        streams through the 4-token budget (4 ticks), the already-
+        decoding slot must emit exactly one token EVERY tick."""
+        cfg = fp32_cfg()
+        model, params = make_model(cfg)
+        eng = greedy_engine(model, params)
+        eng.add_request([1, 2, 3], max_new_tokens=20)
+        # tick 1 prefills [1,2,3] fully; the sampled first token is
+        # fed straight into the fused decode -> TWO tokens in one tick
+        # (the whole-prompt admit-tick cadence, without the pad)
+        eng.step()
+        assert len(eng._slots[0].generated) == 2
+        eng.add_request(list(range(5, 21)), max_new_tokens=4)  # 16 toks
+        for tick in range(4):  # the long prefill occupies 4 full ticks
+            before = len(eng._slots[0].generated)
+            eng.step()
+            assert len(eng._slots[0].generated) == before + 1, (
+                f"decode starved at streaming tick {tick}"
+            )
+        # the long request finished prefill on the 4th streaming tick
+        # and already holds first+second tokens; no decode tick ever
+        # waited on it
+        assert len(eng._slots[1].generated) == 2
+
+    def test_mixed_step_has_no_full_width_prefill_activation(self):
+        """The executable ISSUE-5 acceptance bar: audit the traced
+        mixed step and prove no padded full-prompt-width activation —
+        (1, L, hidden) / (slots, L, hidden) / (1, L, vocab) for the
+        18-token prompt of the parity test or the 24-row pad width —
+        exists anywhere in the program. The legacy whole-prompt
+        prefill, audited the same way, DOES carry its pad-width
+        activation (the waste the scheduler removes)."""
+        from rocm_apex_tpu.monitor import assert_no_intermediate, audit
+
+        cfg = fp32_cfg()
+        model, params = make_model(cfg)
+        eng = greedy_engine(model, params)
+        B, S = eng.prefill_token_budget, eng.num_slots
+        i32 = jnp.int32
+        rng = jax.random.PRNGKey(0)
+        args = (
+            eng.params, eng.cache,
+            jnp.zeros((B,), i32), jnp.full((B,), S, i32),
+            jnp.zeros((B,), i32), jnp.zeros((S,), i32),
+            jnp.zeros((S,), i32), jnp.full((S,), -1, i32),
+            jnp.zeros((S,), i32), jnp.zeros((S,), bool), rng,
+        )
+        h, v = cfg.hidden_size, cfg.vocab_size
+        report = assert_no_intermediate(
+            eng._mixed_fn, (1, 18, h), *args
+        )
+        for shape in [
+            (S, 18, h), (1, 18, v), (1, 24, h), (S, 24, h), (1, 24, v),
+        ]:
+            assert not report.has_intermediate(shape), shape
+        # contrast: the whole-prompt prefill materializes its pad width
+        weng = whole_engine(model, params)
+        wreport = audit(
+            weng._prefill_fn, weng.params, weng.cache,
+            jnp.zeros((1, 24), i32), 0, 18, rng,
+        )
+        assert wreport.has_intermediate((1, 24, h))
+
+    def test_stats_expose_queue_wait_and_ttft_percentiles(self):
+        """Per-request tails (the numbers that surface head-of-line
+        blocking) ride `stats()` alongside the PR-1 counters."""
+        cfg = fp32_cfg()
+        model, params = make_model(cfg)
+        eng = greedy_engine(model, params)
+        s0 = eng.stats()
+        assert s0["ttft_ms_p95"] == 0.0 and s0["queue_wait_ms_p50"] == 0.0
+        eng.generate(
+            [[1, 2, 3], [4, 5], [6, 7, 8, 9]], max_new_tokens=3
+        )
+        s = eng.stats()
+        assert s["admitted"] == 3.0 and s["mixed_steps"] >= 1.0
+        assert s["ttft_ms_p95"] >= s["ttft_ms_p50"] > 0.0
+        assert s["queue_wait_ms_p95"] >= s["queue_wait_ms_p50"] >= 0.0
+        # TTFT includes the queue wait by construction
+        assert s["ttft_ms_p50"] >= s["queue_wait_ms_p50"]

@@ -54,7 +54,10 @@ CASES = [
         ["--num-layers", "2", "--hidden-size", "64",
          "--num-attention-heads", "4", "--max-seq-len", "64",
          "--max-prompt-len", "12", "--num-slots", "2",
-         "--num-requests", "5", "--max-new-tokens", "6"],
+         "--num-requests", "5", "--max-new-tokens", "6",
+         # chunked-prefill scheduler: a budget that does NOT divide
+         # the 12-token prompts, plus the per-request fairness cap
+         "--token-budget", "5", "--prefill-chunk", "4"],
     ),
 ]
 
