@@ -331,7 +331,8 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
     )
 
 
-def bench_serve(budget: int = 0, whole_prompt: bool = False):
+def bench_serve(budget: int = 0, whole_prompt: bool = False,
+                trace: str = ""):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -346,7 +347,17 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False):
     MetricsLogger/JsonlWriter stdout contract. ``--whole-prompt``
     instead reports ONLY the legacy path under ``_whole``-suffixed keys
     (its own BASELINE series). ``--budget=N`` overrides the prefill
-    token budget (default 256 on TPU, 16 on CPU)."""
+    token budget (default 256 on TPU, 16 on CPU).
+
+    ``--trace=PATH`` attaches a `monitor.Tracer` to the measured
+    engine and writes (a) PATH: Chrome trace-event JSON with one track
+    per request (enqueue → queue_wait → prefill_chunk spans → decode →
+    finish) plus the engine's mixed/decode tick track — load it in
+    Perfetto; and (b) PATH.requests.jsonl: the per-request completion
+    records (TTFT, TPOT, tokens, chunks, queue wait) next to the
+    aggregate ``stats()``. Tracing is host-side ring-buffer writes on
+    timestamps the engine already takes — the compiled programs and
+    the one-fetch-per-tick pattern are unchanged."""
     from rocm_apex_tpu.inference import InferenceEngine, SamplingParams
 
     on_tpu = jax.default_backend() == "tpu"
@@ -395,33 +406,55 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False):
     ]
     total_prompt = sum(len(p) for p in prompts)
 
-    def build(chunked):
+    def build(chunked, tracer=None):
         return InferenceEngine(
             model, params, num_slots=num_slots, capacity=capacity,
             max_prompt_len=max(lens),
             sampling=SamplingParams(temperature=0.0), seed=0,
             prefill_token_budget=budget if chunked else None,
+            tracer=tracer,
         )
 
-    def run(chunked):
+    def run(chunked, tracer=None):
         # compile warmup on the SAME engine (its jit caches), then a
         # clean telemetry window for the timed pass — greedy decoding
         # is rng-independent, so the warmup does not perturb tokens
-        eng = build(chunked)
+        eng = build(chunked, tracer)
         eng.generate(prompts[: num_slots], max_new_tokens=3)
         eng.reset_stats()
+        if tracer is not None:
+            tracer.clear()  # the timeline starts at the timed window
         t0 = time.perf_counter()
         results = eng.generate(prompts, max_new_tokens=max_new)
         dt = time.perf_counter() - t0
         gen = sum(len(r.tokens) for r in results)
         return eng, results, gen / dt, dt
 
+    # --trace instruments the MEASURED mode (chunked, or whole under
+    # --whole-prompt) — the A/B contrast numbers stay tracer-free
+    tracer = monitor.Tracer() if trace else None
+    traced_mode = "whole" if whole_prompt else "chunked"
     modes = ["whole"] if whole_prompt else ["whole", "chunked"]
     out = {}
     for mode in modes:
-        eng, results, tok_s, dt = run(mode == "chunked")
+        eng, results, tok_s, dt = run(
+            mode == "chunked",
+            tracer if mode == traced_mode else None,
+        )
         s = eng.stats()
         out[mode] = (tok_s, s, results)
+        if trace and mode == traced_mode:
+            n = tracer.export_chrome_trace(trace)
+            req_path = trace + ".requests.jsonl"
+            with open(req_path, "w") as f:
+                w = monitor.JsonlWriter(stream=f)
+                for rec in eng.completions:
+                    w.emit(rec)
+            print(
+                f"serve trace: {n} events -> {trace}; "
+                f"{len(eng.completions)} request records -> {req_path}",
+                file=sys.stderr,
+            )
         print(
             f"serve[{mode}]: {tok_s:.1f} gen tok/s over {dt:.2f}s "
             f"(prompt_tokens={total_prompt} budget="
@@ -1070,6 +1103,8 @@ if __name__ == "__main__":
             kwargs["budget"] = int(a.split("=", 1)[1])
         elif a == "--whole-prompt":
             kwargs["whole_prompt"] = True
+        elif a.startswith("--trace="):
+            kwargs["trace"] = a.split("=", 1)[1]
         elif a.startswith("--fused="):
             kwargs["fused"] = bool(int(a.split("=", 1)[1]))
         elif a.startswith("--"):
@@ -1100,8 +1135,11 @@ if __name__ == "__main__":
         )
     if (
         "budget" in kwargs or "whole_prompt" in kwargs
+        or "trace" in kwargs
     ) and which != "serve":
-        raise SystemExit("--budget/--whole-prompt apply to the serve bench")
+        raise SystemExit(
+            "--budget/--whole-prompt/--trace apply to the serve bench"
+        )
     if "fused" in kwargs and which != "rn50":
         raise SystemExit("--fused applies to the rn50 bench")
     if kwargs.get("fused") and jax.default_backend() != "tpu":

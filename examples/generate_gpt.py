@@ -31,6 +31,7 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from rocm_apex_tpu.inference import InferenceEngine, SamplingParams
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
+from rocm_apex_tpu.monitor import JsonlWriter, Tracer
 
 
 def main():
@@ -60,6 +61,11 @@ def main():
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace", type=str, default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON of per-request"
+                        " serving timelines to PATH (load in Perfetto)"
+                        " and per-request completion records to"
+                        " PATH.requests.jsonl")
     args = p.parse_args()
 
     cfg = GPTConfig(
@@ -85,6 +91,7 @@ def main():
           f"{jax.default_backend()} backend, "
           f"prefill={'budget %d' % args.token_budget if chunked else 'whole-prompt'}")
 
+    tracer = Tracer(enabled=args.trace is not None)
     eng = InferenceEngine(
         model, params,
         num_slots=args.num_slots,
@@ -98,6 +105,7 @@ def main():
         seed=args.seed,
         prefill_token_budget=args.token_budget if chunked else None,
         prefill_chunk=args.prefill_chunk,
+        tracer=tracer,
     )
 
     rng = np.random.RandomState(args.seed)
@@ -122,6 +130,15 @@ def main():
           f"traces: mixed={eng.mixed_trace_count} "
           f"decode={eng.decode_trace_count} "
           f"prefill={eng.prefill_trace_count}")
+    if args.trace is not None:
+        n = tracer.export_chrome_trace(args.trace)
+        req_path = args.trace + ".requests.jsonl"
+        with open(req_path, "w") as f:
+            w = JsonlWriter(stream=f)
+            for rec in eng.completions:
+                w.emit(rec)
+        print(f"trace: {n} events -> {args.trace}; "
+              f"{len(eng.completions)} request records -> {req_path}")
     if chunked:
         # the fixed-shape contract: ONE mixed program for the whole
         # run regardless of the prompt mix (+ at most one decode-only

@@ -29,9 +29,12 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 from rocm_apex_tpu.amp import all_finite
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
 from rocm_apex_tpu.monitor import (
+    FlightRecorder,
     JsonlWriter,
     Metrics,
     MetricsLogger,
+    Tracer,
+    group_nonfinite,
     model_flops,
     tree_norm,
 )
@@ -41,8 +44,27 @@ from rocm_apex_tpu.transformer.amp import GradScaler
 from rocm_apex_tpu.transformer.testing import parse_args
 
 
+def _observability_args(parser):
+    g = parser.add_argument_group(title="observability")
+    g.add_argument(
+        "--trace", type=str, default=None, metavar="PATH",
+        help="export a Chrome trace-event JSON of the run's step "
+             "spans (monitor.Tracer; load in Perfetto)",
+    )
+    g.add_argument(
+        "--flight-recorder", type=str, default=None, metavar="PATH",
+        const="nan_dump.jsonl", nargs="?",
+        help="arm the numerics flight recorder: per-param-group "
+             "nonfinite probes ride the step metrics and a NaN/Inf "
+             "anomaly dumps a jsonl bundle to PATH "
+             "(monitor.FlightRecorder)",
+    )
+    return parser
+
+
 def main():
     args = parse_args(
+        extra_args_provider=_observability_args,
         defaults=dict(
             num_layers=4, hidden_size=256, num_attention_heads=8,
             seq_length=256, max_position_embeddings=256,
@@ -120,6 +142,14 @@ def main():
             .record("loss_scale", sstate2.loss_scale)
             .record("overflows", sstate2.overflows)
         )
+        if args.flight_recorder is not None:
+            # per-group nonfinite probes for the flight recorder —
+            # shard-partial grads psum over the tensor axis per the
+            # Metrics convention. Gated: the default program carries
+            # ZERO extra equations (the recorder-off acceptance bar).
+            metrics = metrics.merge(Metrics(group_nonfinite(
+                grads, axis_name=parallel_state.TENSOR_AXIS
+            )))
         return state2, sstate2, metrics
 
     data_spec = P(parallel_state.DATA_AXIS)
@@ -175,24 +205,53 @@ def main():
         ),
         n_chips=tp * dp,
     )
-    for it in range(args.train_iters):
-        rng, k = jax.random.split(rng)
-        tokens = jax.random.randint(
-            k, (b_local * dp, seq), 0, cfg.vocab_size
-        )
-        labels = jnp.roll(tokens, -1, axis=1)
-        logger.start_step()
-        state, sstate, metrics = step_f(state, sstate, tokens, labels)
-        logger.end_step(sync_on=metrics["loss"])  # value fetch = sync
-        record = logger.log_step(it + 1, metrics)
-        if record is not None:
-            print(
-                f"iter {it + 1}: lm loss {record['loss']:.4f}  "
-                f"{record['tokens_per_sec']:.0f} tokens/s  "
-                f"grad_norm {record['grad_norm']:.3f}  "
-                f"scale {record['loss_scale']:.0f}",
-                file=sys.stderr,
+    # span tracer (--trace): one host span per train step, aligned
+    # with any live device capture via StepTraceAnnotation; exported
+    # as Perfetto-loadable Chrome trace JSON at the end of the run
+    tracer = Tracer(enabled=args.trace is not None)
+    # numerics flight recorder (--flight-recorder): the last-k metric
+    # snapshots ride a host ring; a NaN/Inf anomaly dumps a jsonl
+    # bundle naming the offending param group
+    recorder = (
+        FlightRecorder(path=args.flight_recorder)
+        if args.flight_recorder is not None else None
+    )
+    # context-managed logger: the trailing partial window (short runs'
+    # last < log_interval steps) flushes on exit
+    with logger:
+        for it in range(args.train_iters):
+            rng, k = jax.random.split(rng)
+            tokens = jax.random.randint(
+                k, (b_local * dp, seq), 0, cfg.vocab_size
             )
+            labels = jnp.roll(tokens, -1, axis=1)
+            logger.start_step()
+            with tracer.step_span(it + 1):
+                state, sstate, metrics = step_f(
+                    state, sstate, tokens, labels
+                )
+                logger.end_step(sync_on=metrics["loss"])  # fetch = sync
+            record = logger.log_step(it + 1, metrics)
+            if recorder is not None:
+                bundle = recorder.record(it + 1, metrics)
+                if bundle is not None:
+                    print(
+                        f"iter {it + 1}: NUMERICS ANOMALY in "
+                        f"{bundle['offending']} -> "
+                        f"{args.flight_recorder}",
+                        file=sys.stderr,
+                    )
+            if record is not None:
+                print(
+                    f"iter {it + 1}: lm loss {record['loss']:.4f}  "
+                    f"{record['tokens_per_sec']:.0f} tokens/s  "
+                    f"grad_norm {record['grad_norm']:.3f}  "
+                    f"scale {record['loss_scale']:.0f}",
+                    file=sys.stderr,
+                )
+    if args.trace is not None:
+        n = tracer.export_chrome_trace(args.trace)
+        print(f"wrote {n} trace events to {args.trace}", file=sys.stderr)
 
 
 if __name__ == "__main__":

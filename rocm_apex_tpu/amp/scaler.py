@@ -183,3 +183,24 @@ class LossScaler:
 
     def loss_scale(self, state: ScalerState) -> jnp.ndarray:
         return state.loss_scale
+
+    def telemetry(self, state: ScalerState, found_inf=None):
+        """name→fp32-scalar dict of the scaler's observable state —
+        the `monitor.Metrics.merge` / `monitor.FlightRecorder` input
+        format (``overflows`` is already in `MetricsLogger`'s default
+        ``last_value`` counter set). Pass the step's ``found_inf`` to
+        make the skip decision itself part of the record: the flight
+        recorder treats a set ``found_inf`` as an anomaly trigger and
+        its dump then names the offending param group next to the
+        scale the scaler is about to halve. Jit-safe (all entries are
+        scalars riding the step outputs; no host sync here)."""
+        out = {
+            "loss_scale": state.loss_scale.astype(jnp.float32),
+            "overflows": state.overflows.astype(jnp.float32),
+            "unskipped": state.unskipped.astype(jnp.float32),
+        }
+        if found_inf is not None:
+            out["found_inf"] = jnp.asarray(
+                found_inf
+            ).astype(jnp.float32)
+        return out

@@ -57,6 +57,7 @@ import numpy as np
 from rocm_apex_tpu import profiler
 from rocm_apex_tpu.inference.kv_cache import KVCache
 from rocm_apex_tpu.inference.sampling import sample
+from rocm_apex_tpu.monitor.trace import NULL_TRACER
 from rocm_apex_tpu.ops._pallas import on_tpu
 
 __all__ = [
@@ -103,6 +104,13 @@ class _Slot:
     generated: List[int]
     pos: int  # tokens materialized in the cache for this slot
     cursor: int = 0  # prompt tokens committed to the cache so far
+    # per-request timeline anchors (perf_counter domain — the SAME
+    # clock as `enqueued_at` and `stats()`): slot lease, first sampled
+    # token, and the count of mixed ticks that carried this request's
+    # prompt tokens. Host floats only — no device traffic.
+    leased_at: float = 0.0
+    first_token_at: float = 0.0
+    chunks: int = 0
 
     @property
     def prefilling(self) -> bool:
@@ -129,6 +137,19 @@ class InferenceEngine:
     ``max_prompt_len``) — the A/B baseline; only this path has a
     prompt-length ceiling.
 
+    ``tracer`` (a `monitor.Tracer`) opts into per-request serving
+    timelines: each request gets its own track with
+    enqueue → queue_wait → prefill_chunk spans (chunk token counts as
+    args) → decode → finish, built from the SAME ``perf_counter``
+    readings that feed ``stats()`` — export with
+    ``tracer.export_chrome_trace(path)`` and the span boundaries
+    reproduce the reported TTFT/queue-wait numbers. Default ``None``
+    is the shared disabled tracer: call sites pay one attribute check,
+    the compiled programs and the one-fetch-per-tick host↔device
+    pattern are untouched. Per-request COMPLETION records (TTFT, TPOT,
+    tokens, chunks, queue wait) accrue on ``completions``
+    unconditionally — pure host bookkeeping.
+
     Single-chip (tp=1) in this PR; the cache layout already stores
     LOCAL head shards, so multi-chip sharded serving is a cache-
     compatible follow-up.
@@ -148,6 +169,7 @@ class InferenceEngine:
         cache_dtype: Any = None,
         prefill_token_budget: Optional[int] = 64,
         prefill_chunk: Optional[int] = None,
+        tracer=None,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -215,6 +237,9 @@ class InferenceEngine:
         self._mixed_steps = 0
         self._queue_waits: List[float] = []
         self._ttfts: List[float] = []
+        # per-request completion records (host-side; see `completions`)
+        self._completions: List[Dict[str, float]] = []
+        self.tracer = tracer if tracer is not None else NULL_TRACER
 
         sp = self.sampling
 
@@ -353,6 +378,21 @@ class InferenceEngine:
     def has_work(self) -> bool:
         return bool(self._queue) or self.num_active > 0
 
+    @property
+    def completions(self) -> List[Dict[str, float]]:
+        """Per-request completion records, one dict per finished
+        request in finish order: ``request_id``, ``finish_reason``,
+        ``prompt_tokens``, ``new_tokens``, ``chunks`` (mixed ticks
+        that carried this prompt; 1 on the whole-prompt path),
+        ``queue_wait_ms`` (enqueue → slot lease), ``ttft_ms``
+        (enqueue → first token — the SAME values whose percentiles
+        ``stats()`` reports), ``tpot_ms`` (mean inter-token time after
+        the first), ``e2e_ms``. Jsonl-ready: route through
+        `monitor.JsonlWriter.emit` (``bench.py serve --trace`` and
+        ``examples/generate_gpt.py --trace`` do). Cleared by
+        `reset_stats`."""
+        return self._completions
+
     def stats(self) -> Dict[str, float]:
         """Serving telemetry as one flat name→scalar dict — the
         `monitor.MetricsLogger.log_step` input format (route the
@@ -426,6 +466,7 @@ class InferenceEngine:
         self._mixed_steps = 0
         self._queue_waits = []
         self._ttfts = []
+        self._completions = []
 
     def add_request(
         self,
@@ -459,12 +500,17 @@ class InferenceEngine:
         if request_id is None:
             request_id = self._next_id
         self._next_id = max(self._next_id, request_id) + 1
-        self._queue.append(
-            Request(
-                request_id, prompt, max_new_tokens,
-                enqueued_at=time.perf_counter(),
-            )
+        req = Request(
+            request_id, prompt, max_new_tokens,
+            enqueued_at=time.perf_counter(),
         )
+        self._queue.append(req)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "enqueue", ts=req.enqueued_at,
+                track=f"req{request_id}",
+                prompt_tokens=len(prompt), max_new_tokens=max_new_tokens,
+            )
         return request_id
 
     def step(self) -> List[GenerationResult]:
@@ -508,8 +554,13 @@ class InferenceEngine:
             self._admitted += 1
             self._queue_waits.append(now - req.enqueued_at)
             self._slots[slot] = _Slot(
-                req=req, generated=[], pos=0, cursor=0
+                req=req, generated=[], pos=0, cursor=0, leased_at=now
             )
+            if self.tracer.enabled:
+                self.tracer.add_span(
+                    "queue_wait", req.enqueued_at, now,
+                    track=f"req{req.request_id}", slot=slot,
+                )
 
     def _step_chunked(self) -> List[GenerationResult]:
         finished: List[GenerationResult] = []
@@ -526,6 +577,7 @@ class InferenceEngine:
         lengths_before = np.zeros((S,), np.int32)
         lengths_after = np.zeros((S,), np.int32)
         completions = []  # (slot, chunk index of the last prompt token)
+        packed = []  # (slot, tokens, start_pos) — tracer span payload
         used = 0
         # slot order keeps the packed segment ids non-decreasing (the
         # varlen kernel's contract)
@@ -546,8 +598,10 @@ class InferenceEngine:
             chunk_pos[used:used + n] = np.arange(
                 st.cursor, st.cursor + n
             )
+            packed.append((slot, n, st.cursor))
             st.cursor += n
             st.pos = st.cursor
+            st.chunks += 1
             lengths_after[slot] = st.cursor
             self._prompt_tokens += n
             if not st.prefilling:
@@ -591,10 +645,23 @@ class InferenceEngine:
             # ONE batched value fetch per tick (= the device sync) —
             # never a per-request scalar pull
             chunk_out, dec_out = jax.device_get((chunk_tok, dec_tok))
-            self._prefill_seconds += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self._prefill_seconds += t1 - t0
             self._mixed_steps += 1
             if active.any() or completions:
                 self._decode_steps += 1
+            if self.tracer.enabled:
+                self.tracer.add_span(
+                    "mixed_step", t0, t1, track="engine",
+                    chunk_tokens=used, decodes=int(active.sum()),
+                )
+                for slot, n, start_pos in packed:
+                    st = self._slots[slot]
+                    self.tracer.add_span(
+                        "prefill_chunk", t0, t1,
+                        track=f"req{st.req.request_id}",
+                        tokens=n, start_pos=start_pos, slot=slot,
+                    )
         elif active.any():
             self._rng, rng = jax.random.split(self._rng)
             t0 = time.perf_counter()
@@ -606,14 +673,21 @@ class InferenceEngine:
                     jnp.asarray(active), rng,
                 )
             dec_out = np.asarray(tok)  # value fetch = device sync
-            self._decode_seconds += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self._decode_seconds += t1 - t0
             self._decode_steps += 1
+            if self.tracer.enabled:
+                self.tracer.add_span(
+                    "decode_step", t0, t1, track="engine",
+                    decodes=int(active.sum()),
+                )
 
         now2 = time.perf_counter()
         for slot, idx in completions:
             st = self._slots[slot]
             st.generated.append(int(chunk_out[idx]))
             self._generated_tokens += 1
+            st.first_token_at = now2
             self._ttfts.append(now2 - st.req.enqueued_at)
             done = self._finish_reason(st)
             if done is not None:
@@ -655,6 +729,11 @@ class InferenceEngine:
                 continue
             req = self._queue.popleft()
             self._queue_waits.append(t_admit - req.enqueued_at)
+            if self.tracer.enabled:
+                self.tracer.add_span(
+                    "queue_wait", req.enqueued_at, t_admit,
+                    track=f"req{req.request_id}", slot=slot,
+                )
             toks = np.zeros((1, self.max_prompt_len), np.int32)
             toks[0, : len(req.prompt)] = req.prompt
             self._rng, rng = jax.random.split(self._rng)
@@ -669,7 +748,7 @@ class InferenceEngine:
             self._prompt_tokens += len(req.prompt)
             self._slots[slot] = _Slot(
                 req=req, generated=[], pos=len(req.prompt),
-                cursor=len(req.prompt),
+                cursor=len(req.prompt), leased_at=t_admit, chunks=1,
             )
             pending.append((slot, tok))
         if pending:
@@ -683,7 +762,14 @@ class InferenceEngine:
                 st = self._slots[slot]
                 st.generated.append(int(tok))
                 self._generated_tokens += 1
+                st.first_token_at = now
                 self._ttfts.append(now - st.req.enqueued_at)
+                if self.tracer.enabled:
+                    self.tracer.add_span(
+                        "prefill", st.leased_at, now,
+                        track=f"req{st.req.request_id}",
+                        tokens=len(st.req.prompt), slot=slot,
+                    )
                 done = self._finish_reason(st)
                 if done is not None:
                     finished.append(self._evict(slot, st, done))
@@ -740,9 +826,38 @@ class InferenceEngine:
     ) -> GenerationResult:
         self._slots[slot] = None
         self._evicted += 1
+        finished_at = time.perf_counter()
+        req = state.req
+        n_new = len(state.generated)
+        # the jsonl-ready per-request completion record: the same
+        # perf_counter anchors the tracer spans and `stats()` use, so
+        # the three reports can never disagree about one request
+        self._completions.append({
+            "request_id": req.request_id,
+            "finish_reason": reason,
+            "prompt_tokens": len(req.prompt),
+            "new_tokens": n_new,
+            "chunks": state.chunks,
+            "queue_wait_ms": 1e3 * (state.leased_at - req.enqueued_at),
+            "ttft_ms": 1e3 * (state.first_token_at - req.enqueued_at),
+            "tpot_ms": (
+                1e3 * (finished_at - state.first_token_at)
+                / max(n_new - 1, 1)
+            ),
+            "e2e_ms": 1e3 * (finished_at - req.enqueued_at),
+        })
+        if self.tracer.enabled:
+            track = f"req{req.request_id}"
+            self.tracer.add_span(
+                "decode", state.first_token_at, finished_at,
+                track=track, tokens=n_new, slot=slot,
+            )
+            self.tracer.instant(
+                "finish", ts=finished_at, track=track, reason=reason,
+            )
         return GenerationResult(
-            request_id=state.req.request_id,
-            prompt=list(state.req.prompt),
+            request_id=req.request_id,
+            prompt=list(req.prompt),
             tokens=list(state.generated),
             finish_reason=reason,
         )

@@ -1,4 +1,4 @@
-"""`rocm_apex_tpu.monitor` — training/serving observability, three pillars.
+"""`rocm_apex_tpu.monitor` — training/serving observability, five pillars.
 
 The reference scattered its telemetry (nvmarker payloads in pyprof,
 `_timers.py` synchronized timers, the amp scaler's overflow counter);
@@ -15,11 +15,21 @@ this package is the shared layer the ROADMAP's production story needs:
 * **static auditor** (`audit.py`): walk a `ClosedJaxpr` and report
   collective counts/bytes and dot FLOPs — the executable form of the
   PR-3 "no gathered activation / ring collectives" invariants, and
-  bench.py's ``--audit`` report.
+  bench.py's ``--audit`` report;
+* **span tracer** (`trace.py`): host-side wall-clock spans in a
+  thread-safe ring buffer, exported as Perfetto-loadable Chrome trace
+  JSON and aligned with device captures via
+  `jax.profiler.TraceAnnotation` — the serving engine's per-request
+  timelines and the train loop's step spans ride it;
+* **flight recorder** (`recorder.py`): last-k step snapshots plus
+  in-graph per-param-group nonfinite probes; on a NaN/Inf anomaly it
+  dumps a jsonl bundle naming the offending group — a mid-run NaN
+  becomes a diagnosable artifact instead of a dead run.
 
 See docs/observability.md for the full tour; `rocm_apex_tpu.profiler`
 remains the trace-capture layer (device timelines), while this package
-owns the per-step scalar stream and static program accounting.
+owns the per-step scalar stream, wall-clock spans, and static program
+accounting.
 """
 
 from rocm_apex_tpu.monitor.audit import (
@@ -42,6 +52,8 @@ from rocm_apex_tpu.monitor.logger import (
     device_memory_stats,
 )
 from rocm_apex_tpu.monitor.metrics import Metrics, activation_stats, tree_norm
+from rocm_apex_tpu.monitor.recorder import FlightRecorder, group_nonfinite
+from rocm_apex_tpu.monitor.trace import NULL_TRACER, Tracer
 
 __all__ = [
     "Metrics",
@@ -60,4 +72,8 @@ __all__ = [
     "audit",
     "audit_jaxpr",
     "assert_no_intermediate",
+    "Tracer",
+    "NULL_TRACER",
+    "FlightRecorder",
+    "group_nonfinite",
 ]

@@ -41,21 +41,28 @@ __all__ = [
 
 
 def device_memory_stats(device=None) -> Dict[str, float]:
-    """{'mem_bytes_in_use': ..., 'mem_peak_bytes_in_use': ...} for one
-    device; empty where the backend has no allocator stats (CPU)."""
+    """{'platform': ..., 'mem_bytes_in_use': ...,
+    'mem_peak_bytes_in_use': ...} for one device.
+
+    Backends without allocator stats (the CPU tier-1 box) get ZEROED
+    fields rather than missing keys or an exception — downstream
+    jsonl streams keep a stable schema across platforms, and the
+    ``platform`` name says which case a record came from."""
     if device is None:
         import jax
 
         device = jax.local_devices()[0]
+    out: Dict[str, float] = {
+        "platform": str(getattr(device, "platform", "unknown")),
+        "mem_bytes_in_use": 0.0,
+        "mem_peak_bytes_in_use": 0.0,
+    }
     try:
         stats = device.memory_stats()
     except Exception:  # noqa: BLE001 - backend without allocator stats
         stats = None
-    if not stats:
-        return {}
-    out = {}
     for key in ("bytes_in_use", "peak_bytes_in_use"):
-        if key in stats:
+        if stats and key in stats:
             out[f"mem_{key}"] = float(stats[key])
     return out
 
@@ -101,7 +108,11 @@ class TensorBoardWriter:
 
     def write(self, step: int, scalars: Dict[str, Any]) -> None:
         for tag, value in scalars.items():
-            self._w.add_scalar(tag, float(value), int(step))
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue  # non-scalar entries (e.g. 'platform') skip
+            self._w.add_scalar(tag, value, int(step))
 
     def add_scalar(self, tag: str, value, step: int) -> None:
         self._w.add_scalar(tag, float(value), int(step))
@@ -169,6 +180,7 @@ class MetricsLogger:
         self._count = 0
         self._step_seconds = 0.0
         self._timed_steps = 0
+        self._last_step = 0
 
     # -- step timing (Timers sync semantics) ---------------------------
 
@@ -192,6 +204,7 @@ class MetricsLogger:
         if hasattr(scalars, "as_dict"):
             scalars = scalars.as_dict()
         scalars = {**scalars, **extra}
+        self._last_step = int(step)
         for name, value in scalars.items():
             value = float(value)
             self._last[name] = value
@@ -234,6 +247,34 @@ class MetricsLogger:
         self._step_seconds = 0.0
         self._timed_steps = 0
         return record
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self) -> Optional[Dict]:
+        """Flush the trailing PARTIAL window (a run whose length is not
+        a multiple of ``window`` would silently lose its last
+        ``< window`` steps), then ``close()`` every writer that has
+        one (`JsonlWriter` owning a file closes it). Returns the final
+        flushed record, or None if the window was empty. Idempotent —
+        and available as a context manager::
+
+            with MetricsLogger(...) as logger:
+                for it in range(iters):
+                    ...
+                    logger.log_step(it, metrics)
+            # trailing steps flushed, writers closed
+        """
+        record = self.flush(self._last_step)
+        for w in self.writers:
+            if hasattr(w, "close"):
+                w.close()
+        return record
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # -- raw passthrough (the bench driver's stdout contract) -----------
 

@@ -1,0 +1,165 @@
+"""Numerics flight recorder: last-k step snapshots + NaN provenance.
+
+A mid-run NaN today is a dead run: by the time the loss prints ``nan``
+the step that poisoned it is gone, and nothing says WHICH parameter
+group went first. The recorder turns that into an artifact:
+
+* **in-graph probes** (`group_nonfinite`): one 0/1 flag per top-level
+  parameter group, computed with the amp scaler's own sum-poisoning
+  probe (`amp.scaler.all_finite` semantics: a single fp32 reduction
+  per group whose total goes non-finite iff any element is — never a
+  materialized bool tensor) and following the Metrics psum convention
+  for shard-partial trees. The flags ride the step's Metrics pytree,
+  so they share the step's existing device→host fetch — no new syncs,
+  and when not called they add ZERO equations to the program (the
+  jaxpr-asserted off-path in tests/L0/test_trace.py);
+* **host ring buffer** (`FlightRecorder.record`): the last ``last_k``
+  steps' scalar snapshots. On an anomaly — any non-finite snapshot
+  value, or any ``nonfinite/<group>`` flag set, or a ``found_inf``
+  entry firing — it dumps a jsonl bundle: the anomalous step, the loss
+  scale, the offending group names, and the full history window. The
+  amp scaler's skip-path already makes the step itself survivable
+  (`ScalerState.overflows` counts it); the dump makes it diagnosable.
+
+Wiring (examples/gpt_train.py ``--flight-recorder``)::
+
+    metrics = metrics.merge(Metrics(group_nonfinite(grads)))   # in-graph
+    ...
+    recorder = FlightRecorder(path="nan_dump.jsonl", last_k=32)
+    bundle = recorder.record(step, metrics)    # host side, per step
+    if bundle is not None: ...                 # anomaly dumped
+"""
+
+import json
+import math
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from rocm_apex_tpu.monitor.metrics import _psum, _top_level_groups
+
+__all__ = ["FlightRecorder", "group_nonfinite"]
+
+
+def group_nonfinite(
+    tree: Any,
+    prefix: str = "nonfinite",
+    axis_name: Optional[str] = None,
+) -> Dict[str, jnp.ndarray]:
+    """``{"nonfinite/<group>": 0.0|1.0}`` per top-level group of
+    ``tree`` (the `Metrics.record_ratio_norms` grouping: embedding /
+    transformer / ...).
+
+    Each flag is the scaler's fused probe at group granularity: the
+    group's fp32 leaf sums are added into one scalar, which is finite
+    iff every element is (inf meeting -inf yields nan — still caught).
+    With ``axis_name`` the partial sums psum over the mesh axis BEFORE
+    the finiteness test (the Metrics shard_map convention), so every
+    rank reports the same global flag with one collective per group.
+    Feed the result to ``Metrics.merge(Metrics(group_nonfinite(g)))``.
+    """
+    out: Dict[str, jnp.ndarray] = {}
+    for name, sub in sorted(_top_level_groups(tree).items()):
+        leaves = [
+            x
+            for x in jax.tree_util.tree_leaves(sub)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+        ]
+        if not leaves:
+            continue
+        probe = sum(jnp.sum(x.astype(jnp.float32)) for x in leaves)
+        probe = _psum(probe, axis_name)
+        out[f"{prefix}/{name}"] = (
+            ~jnp.isfinite(probe)
+        ).astype(jnp.float32)
+    return out
+
+
+class FlightRecorder:
+    """Host-side ring of the last ``last_k`` step snapshots with
+    anomaly-triggered jsonl dumps.
+
+    ``record(step, metrics)`` accepts a `Metrics`, any mapping, or
+    anything with ``as_dict()``; values are fetched with ``float``
+    (the step's outputs are already materialized by the time a train
+    loop logs — this adds no dispatch). Returns the dump bundle when
+    this step is anomalous, else None.
+
+    Anomaly = any non-finite snapshot value, any ``<prefix>/<group>``
+    flag > 0, or a truthy ``found_inf`` entry. ``max_dumps`` caps the
+    bundles written (a persistently-NaN run must not fill the disk);
+    ``offending()`` and ``dumps`` expose the history programmatically.
+    """
+
+    def __init__(
+        self,
+        last_k: int = 32,
+        path: Optional[str] = None,
+        prefix: str = "nonfinite",
+        max_dumps: int = 8,
+    ):
+        if last_k < 1:
+            raise ValueError(f"last_k must be >= 1, got {last_k}")
+        self.last_k = last_k
+        self.path = path
+        self.prefix = prefix + "/"
+        self.max_dumps = max_dumps
+        self._ring: deque = deque(maxlen=last_k)
+        self.dumps: List[Dict[str, Any]] = []
+
+    # -- per-step ingestion ---------------------------------------------
+
+    def record(self, step: int, metrics, **extra) -> Optional[Dict]:
+        """Snapshot one step; dump and return the bundle on anomaly."""
+        if hasattr(metrics, "as_dict"):
+            metrics = metrics.as_dict()
+        snap: Dict[str, float] = {"step": int(step)}
+        for name, value in {**metrics, **extra}.items():
+            snap[name] = float(value)
+        self._ring.append(snap)
+        offending = self.offending(snap)
+        if not offending:
+            return None
+        return self._dump(snap, offending)
+
+    def offending(self, snap: Dict[str, float]) -> List[str]:
+        """The anomalous entries of one snapshot: group names whose
+        nonfinite flag fired, plus any metric that is itself
+        non-finite, plus ``found_inf`` when set."""
+        out = []
+        for name, value in snap.items():
+            if name == "step":
+                continue
+            if name.startswith(self.prefix):
+                if value > 0.0:
+                    out.append(name[len(self.prefix):])
+            elif name == "found_inf":
+                if value > 0.0:
+                    out.append(name)
+            elif not math.isfinite(value):
+                out.append(name)
+        return out
+
+    # -- dumping --------------------------------------------------------
+
+    def _dump(self, snap: Dict[str, float], offending) -> Dict[str, Any]:
+        bundle = {
+            "event": "numerics_anomaly",
+            "step": snap["step"],
+            "offending": offending,
+            "loss_scale": snap.get("loss_scale"),
+            "snapshot": snap,
+            # the ring INCLUDES the anomalous step (it was just
+            # appended): the window a postmortem wants is "the k steps
+            # leading into the blow-up"
+            "history": list(self._ring),
+        }
+        if len(self.dumps) < self.max_dumps:
+            self.dumps.append(bundle)
+            if self.path is not None:
+                with open(self.path, "a") as f:
+                    json.dump(bundle, f)
+                    f.write("\n")
+        return bundle

@@ -1,0 +1,254 @@
+"""Host-side span tracer: wall-clock timelines as Chrome trace events.
+
+The fourth monitor pillar. The existing three answer "what did the
+step compute" (`Metrics`), "what does the stream look like over time"
+(`MetricsLogger`), and "what does the program move" (`audit`) — but
+every claim about TIME so far is an aggregate: the serving engine
+reports TTFT percentiles with no way to see why ONE request was slow,
+and the PR-3 ring-overlap story is asserted statically, never shown on
+a timeline. `Tracer` is the instrument:
+
+* ``tracer.span("prefill", tokens=n)`` — a context manager recording a
+  wall-clock span into a thread-safe ring buffer (bounded memory: a
+  long serving run keeps the last ``capacity`` events, oldest dropped);
+* spans also enter `jax.profiler.TraceAnnotation` scopes (and
+  `step_span` a `StepTraceAnnotation`), so when a device capture
+  (`profiler.trace`) is live, the host spans land on the SAME captured
+  timeline as the XLA ops — host scheduling gaps and device ring hops
+  line up in one Perfetto view;
+* ``export_chrome_trace(path)`` writes the standard Chrome trace-event
+  JSON (``ph: "X"`` complete events over named tracks), loadable in
+  Perfetto / ``chrome://tracing`` with no converter;
+* retrospective ``add_span(name, begin, end)`` records a span from
+  timestamps the caller already holds — the serving engine's
+  per-request timelines are built this way from the SAME
+  ``perf_counter`` readings that feed ``stats()``, so trace-span
+  boundaries reproduce the reported TTFT/queue-wait numbers exactly.
+
+The DISABLED path is the default and must cost nothing: module-level
+``NULL_TRACER`` is a shared singleton whose ``span()`` returns one
+preallocated no-op context manager — call sites pay an attribute check
+(``tracer.enabled``), never an allocation, and the engine's compiled
+programs and host↔device fetch pattern are untouched (pinned by
+tests/L0/test_trace.py).
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+
+__all__ = ["Tracer", "NULL_TRACER"]
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path (one
+    module-level instance; entering it allocates nothing)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Live span handle: records on exit, annotates the device
+    timeline while open."""
+
+    __slots__ = ("_tracer", "name", "track", "args", "_t0", "_ann")
+
+    def __init__(self, tracer, name, track, args, annotation):
+        self._tracer = tracer
+        self.name = name
+        self.track = track
+        self.args = args
+        self._ann = annotation
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._t0 = self._tracer.clock()
+        return self
+
+    def __exit__(self, *exc):
+        end = self._tracer.clock()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._tracer.add_span(
+            self.name, self._t0, end, track=self.track, **self.args
+        )
+        return False
+
+
+class Tracer:
+    """Thread-safe wall-clock span recorder with Chrome-JSON export.
+
+    ``capacity`` bounds the ring buffer (oldest events drop — a
+    serving run can trace forever in constant memory);
+    ``annotate_device=True`` (default) additionally wraps every live
+    `span` in a `jax.profiler.TraceAnnotation` so a concurrent
+    `profiler.trace` capture shows the host spans against the device
+    ops. All timestamps are ``time.perf_counter`` seconds relative to
+    the tracer's creation (one clock — the engine's ``stats()``
+    latencies and the exported spans can be compared directly).
+
+    Construct with ``enabled=False`` (or use the shared
+    ``NULL_TRACER``) for the free disabled path: ``span`` returns a
+    shared no-op context manager and every ``add_*`` returns
+    immediately.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        capacity: int = 65536,
+        annotate_device: bool = True,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.enabled = bool(enabled)
+        self.annotate_device = annotate_device
+        self.clock = time.perf_counter
+        self._t0 = self.clock()
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        # track name -> tid, in registration order (Perfetto sorts by
+        # the sort_index metadata we export, so registration order IS
+        # display order: engine track first, then requests as admitted)
+        self._tracks: Dict[str, int] = {}
+
+    # -- recording ------------------------------------------------------
+
+    def span(self, name: str, track: Optional[str] = None, **args):
+        """Context manager timing a live region (one ring-buffer event
+        on exit; a `TraceAnnotation` scope while open)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        ann = None
+        if self.annotate_device:
+            label = name
+            if args:
+                label = f"{name}|{json.dumps(args, default=str, sort_keys=True)}"
+            ann = jax.profiler.TraceAnnotation(label)
+        return _Span(self, name, track, args, ann)
+
+    def step_span(self, step: int, name: str = "train_step"):
+        """`StepTraceAnnotation`-aligned span for one train step: the
+        profiler groups the device ops under the step number, and the
+        host-side span records wall time for the same tick."""
+        if not self.enabled:
+            return _NULL_SPAN
+        ann = None
+        if self.annotate_device:
+            ann = jax.profiler.StepTraceAnnotation(name, step_num=step)
+        return _Span(self, name, None, {"step": int(step)}, ann)
+
+    def add_span(
+        self,
+        name: str,
+        begin: float,
+        end: float,
+        track: Optional[str] = None,
+        **args,
+    ) -> None:
+        """Record a completed span from caller-held ``perf_counter``
+        timestamps (the engine's retrospective per-request spans)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                ("X", name, self._tid_locked(track), begin, end - begin, args)
+            )
+
+    def instant(
+        self, name: str, ts: Optional[float] = None,
+        track: Optional[str] = None, **args,
+    ) -> None:
+        """Record a zero-duration marker (request enqueue/finish)."""
+        if not self.enabled:
+            return
+        if ts is None:
+            ts = self.clock()
+        with self._lock:
+            self._events.append(
+                ("i", name, self._tid_locked(track), ts, 0.0, args)
+            )
+
+    def _tid_locked(self, track: Optional[str]) -> int:
+        if track is None:
+            track = "main"
+        tid = self._tracks.get(track)
+        if tid is None:
+            tid = len(self._tracks)
+            self._tracks[track] = tid
+        return tid
+
+    # -- access / export ------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Chrome trace-event dicts (host pid 1, ts/dur in µs since
+        tracer creation) — the body `export_chrome_trace` writes."""
+        with self._lock:
+            snap = list(self._events)
+            tracks = dict(self._tracks)
+        out: List[Dict[str, Any]] = []
+        for track, tid in tracks.items():
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
+                "args": {"name": track},
+            })
+            out.append({
+                "ph": "M", "name": "thread_sort_index", "pid": 1,
+                "tid": tid, "args": {"sort_index": tid},
+            })
+        for ph, name, tid, ts, dur, args in snap:
+            ev: Dict[str, Any] = {
+                "ph": ph, "name": name, "pid": 1, "tid": tid,
+                "ts": round((ts - self._t0) * 1e6, 3),
+            }
+            if ph == "X":
+                ev["dur"] = round(dur * 1e6, 3)
+            else:
+                ev["s"] = "t"  # instant scope: thread
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the Perfetto-loadable JSON; returns the event count
+        (metadata included)."""
+        events = self.events()
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "traceEvents": events,
+                    "displayTimeUnit": "ms",
+                    "otherData": {
+                        "producer": "rocm_apex_tpu.monitor.trace",
+                        "process_name": "host",
+                    },
+                },
+                f,
+            )
+        return len(events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._tracks.clear()
+
+
+# The free default: share one disabled tracer so every call site can
+# hold a tracer unconditionally and pay only `tracer.enabled` checks.
+NULL_TRACER = Tracer(enabled=False, capacity=1)

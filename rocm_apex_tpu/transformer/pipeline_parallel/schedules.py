@@ -75,7 +75,7 @@ from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.utils.compat import axis_size, pcast_varying
 
 
-def _start_timer(timers, forward_only):
+def _start_timer(timers, forward_only, tracer=None, microbatches=0):
     """Observability hook (rocm_apex_tpu.monitor): every schedule takes
     ``timers=`` (a `transformer._timers.Timers`) and times the whole
     schedule call under ``pipeline/forward`` / ``pipeline/fwd-bwd``.
@@ -84,22 +84,39 @@ def _start_timer(timers, forward_only):
     stop records trace/build time only and the in-graph phase
     attribution comes from the ``pp_fwd``/``pp_bwd``/``pp_comm``/
     ``pp_head`` named scopes instead (visible to `profiler.op_stats` —
-    one fused scan admits no host-side phase timers)."""
+    one fused scan admits no host-side phase timers).
+
+    ``tracer=`` (a `monitor.Tracer`) records the same region as a span
+    on the host timeline (and a `jax.profiler.TraceAnnotation` scope,
+    so a live device capture shows the schedule boundary); the shared
+    disabled tracer makes the default free."""
+    name = "pipeline/forward" if forward_only else "pipeline/fwd-bwd"
+    span = None
+    if tracer is not None and tracer.enabled:
+        span = tracer.span(name, track="pipeline",
+                           microbatches=int(microbatches))
+        span.__enter__()
     if timers is None:
-        return None
-    t = timers("pipeline/forward" if forward_only else "pipeline/fwd-bwd")
+        return None, span
+    t = timers(name)
     t.start()
-    return t
+    return t, span
 
 
-def _finish_timer(t, out):
-    if t is None:
-        return out
-    leaves = [x for x in jax.tree_util.tree_leaves(out) if x is not None]
-    sync = None
-    if leaves and not any(isinstance(x, _jax_core.Tracer) for x in leaves):
-        sync = leaves[0]
-    t.stop(sync_on=sync)
+def _finish_timer(obs, out):
+    t, span = obs
+    if t is not None:
+        leaves = [
+            x for x in jax.tree_util.tree_leaves(out) if x is not None
+        ]
+        sync = None
+        if leaves and not any(
+            isinstance(x, _jax_core.Tracer) for x in leaves
+        ):
+            sync = leaves[0]
+        t.stop(sync_on=sync)
+    if span is not None:
+        span.__exit__(None, None, None)
     return out
 
 
@@ -244,6 +261,7 @@ def forward_backward_no_pipelining(
     extra_params: Any = None,
     pre_fn=None,
     timers=None,
+    tracer=None,
     **unused_kw,
 ):
     """Sequential microbatch loop with gradient accumulation.
@@ -259,7 +277,7 @@ def forward_backward_no_pipelining(
     m = inputs.shape[0]
     body = _maybe_checkpoint(stage_fn, checkpoint_stages)
     has_extra = extra_params is not None
-    tmr = _start_timer(timers, forward_only)
+    tmr = _start_timer(timers, forward_only, tracer, m)
 
     def one_loss(p, extra, x, t):
         with jax.named_scope("pp_fwd"):
@@ -350,6 +368,7 @@ def forward_backward_pipelining_without_interleaving(
     extra_params: Any = None,
     pre_fn=None,
     timers=None,
+    tracer=None,
     **unused_kw,
 ):
     """The 1F1B linear pipeline.
@@ -430,7 +449,7 @@ def forward_backward_pipelining_without_interleaving(
         )
         return jnp.mean(loss_buf), loss_buf
 
-    tmr = _start_timer(timers, forward_only)
+    tmr = _start_timer(timers, forward_only, tracer, m)
     if forward_only:
         _, losses = run(local_params, extra_params)
         return _finish_timer(tmr, (losses, None))
@@ -690,6 +709,7 @@ def forward_backward_pipelining_with_interleaving(
     extra_params: Any = None,
     pre_fn=None,
     timers=None,
+    tracer=None,
     **unused_kw,
 ):
     """Interleaved virtual stages as a circular pipeline.
@@ -781,7 +801,7 @@ def forward_backward_pipelining_with_interleaving(
         )
         return jnp.mean(loss_buf), loss_buf
 
-    tmr = _start_timer(timers, forward_only)
+    tmr = _start_timer(timers, forward_only, tracer, m)
     if forward_only:
         _, losses = run(params, extra_params)
         return _finish_timer(tmr, (losses, None))

@@ -246,6 +246,63 @@ class TestMetricsLogger:
         lg.log_step(5, {"loss": 2.5})
         assert ("loss", 2.5, 5) in rows
 
+    def test_close_flushes_trailing_partial_window(self, tmp_path):
+        """A run whose length is not a multiple of `window` used to
+        lose its last < window steps; `close()` (and the context-
+        manager form) flushes them and closes owned writers."""
+        path = tmp_path / "metrics.jsonl"
+        w = JsonlWriter(path=str(path))
+        with MetricsLogger(
+            writers=[w], window=5, memory_stats=False
+        ) as lg:
+            for it in range(7):
+                lg.log_step(it, {"x": float(it)})
+        rows = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [r["step"] for r in rows] == [4, 6]
+        assert rows[0]["x"] == pytest.approx(2.0)  # mean of 0..4
+        assert rows[1]["x"] == pytest.approx(5.5)  # trailing 5, 6
+        assert w._stream.closed  # JsonlWriter.close called through
+        # idempotent: a second close flushes nothing and re-closes
+        assert lg.close() is None
+
+    def test_close_on_empty_window_writes_nothing(self):
+        buf = io.StringIO()
+        lg = MetricsLogger(
+            writers=[JsonlWriter(stream=buf)], window=3,
+            memory_stats=False,
+        )
+        lg.log_step(0, {"x": 1.0})
+        lg.log_step(1, {"x": 2.0})
+        lg.log_step(2, {"x": 3.0})  # window flushed exactly here
+        assert lg.close() is None  # nothing trailing
+        assert len(buf.getvalue().strip().splitlines()) == 1
+
+    def test_device_memory_stats_zeroed_with_platform_on_cpu(self):
+        """Backends without allocator stats (the CPU tier-1 box) get a
+        STABLE schema — zeroed fields plus the platform name — instead
+        of missing keys; writers that only take numbers skip the
+        string cleanly."""
+        from rocm_apex_tpu.monitor import device_memory_stats
+
+        s = device_memory_stats()
+        assert s["platform"] == "cpu"  # conftest pins the platform
+        assert s["mem_bytes_in_use"] == 0.0
+        assert s["mem_peak_bytes_in_use"] == 0.0
+        rows = []
+
+        class Sink:
+            def add_scalar(self, tag, value, step):
+                rows.append(tag)
+
+        TensorBoardWriter(Sink()).write(0, s)
+        assert "mem_bytes_in_use" in rows and "platform" not in rows
+        # the default logger pipeline carries it end to end as jsonl
+        buf = io.StringIO()
+        lg = MetricsLogger(writers=[JsonlWriter(stream=buf)], window=1)
+        lg.log_step(0, {"loss": 1.0})
+        row = json.loads(buf.getvalue())
+        assert row["platform"] == "cpu" and row["mem_bytes_in_use"] == 0.0
+
     def test_jsonl_add_scalar_is_timers_write_compatible(self):
         """`Timers.write(names, writer, it)` lands timer rows in the
         same jsonl stream the metrics use."""
@@ -368,6 +425,67 @@ class TestAuditBasics:
 
         r = audit(f, jnp.ones((4, 4)))
         assert r.dot_count == 2  # max over branches, not the sum of 3
+
+    def test_while_loop_body_counts_once_as_lower_bound(self):
+        """`lax.while_loop` has a DYNAMIC trip count: the auditor
+        counts the body exactly once and flags the totals as lower
+        bounds (the documented convention, until now untested)."""
+        mesh = _mesh(2)
+
+        def f(x):
+            def cond(c):
+                i, _ = c
+                return i < 5
+
+            def body(c):
+                i, v = c
+                v = jax.lax.psum(v, "tensor")
+                v = jax.lax.ppermute(v, "tensor", [(0, 1), (1, 0)])
+                return i + 1, v @ v
+
+            _, v = jax.lax.while_loop(
+                cond, body, (jnp.asarray(0), x)
+            )
+            return v
+
+        g = shard_map(
+            f, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_rep=False,
+        )
+        r = audit(g, jnp.ones((4, 4), jnp.float32))
+        # 5 runtime trips, ONE counted: exact per-body, a lower bound
+        # in total — and the report says so
+        assert r.count("psum") == 1
+        assert r.count("ppermute") == 1
+        assert r.dot_count == 1
+        assert r.bytes("psum") == pytest.approx(4 * 4 * 4)
+        assert r.while_lower_bound
+        assert "lower bounds" in r.summary()
+
+    def test_while_inside_scan_scales_and_stays_flagged(self):
+        """A while body under a scan still multiplies by the SCAN trip
+        count (the static part of the product is exact; the flag marks
+        the dynamic part)."""
+        def f(x):
+            def outer(c, _):
+                def cond(s):
+                    i, _ = s
+                    return i < 3
+
+                def body(s):
+                    i, v = s
+                    return i + 1, v @ v
+
+                _, v = jax.lax.while_loop(
+                    cond, body, (jnp.asarray(0), c)
+                )
+                return v, None
+
+            return jax.lax.scan(f=outer, init=x, xs=None, length=4)[0]
+
+        r = audit(f, jnp.ones((4, 4), jnp.float32))
+        assert r.dot_count == 4  # 4 scan trips x 1 counted body dot
+        assert r.while_lower_bound
 
 
 def _sp_cfg(collective_matmul, **kw):

@@ -110,6 +110,23 @@ def main() -> int:
               "references (ROADMAP); if the cost is justified, "
               "re-baseline with --update in the same PR and say so in "
               "the PR description.")
+        # the total alone does not say WHERE the time went: name the
+        # worst per-test regressions of tests the baseline already
+        # knows (a changed fixture/config slows old tests without any
+        # new test id appearing above)
+        regressions = sorted(
+            (
+                (durations[k] - known[k], k)
+                for k in durations
+                if k in known and durations[k] > known[k]
+            ),
+            reverse=True,
+        )[:10]
+        if regressions:
+            print("  top-10 per-test regressions vs baseline:")
+            for delta, k in regressions:
+                print(f"    +{delta:6.2f}s  {k} "
+                      f"({known[k]:.2f} -> {durations[k]:.2f}s)")
         return 1
     print("OK: within the new-test budget")
     return 0
