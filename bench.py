@@ -332,7 +332,9 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
 
 
 def bench_serve(budget: int = 0, whole_prompt: bool = False,
-                trace: str = ""):
+                trace: str = "", paged: bool = False,
+                page_size: int = 0, kv_dtype: str = "",
+                shared_prefix: bool = False):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -357,7 +359,22 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     records (TTFT, TPOT, tokens, chunks, queue wait) next to the
     aggregate ``stats()``. Tracing is host-side ring-buffer writes on
     timestamps the engine already takes — the compiled programs and
-    the one-fetch-per-tick pattern are unchanged."""
+    the one-fetch-per-tick pattern are unchanged.
+
+    ``--paged`` A/Bs the block-table cache against the contiguous
+    chunked engine on the same workload: greedy tokens are asserted
+    IDENTICAL (the bf16/fp32 paged path is parity-exact), throughput
+    reports under ``gpt_serve_tokens_per_sec_per_chip_paged`` with
+    vs_baseline = paged/contiguous, and a cache-bytes line contrasts
+    the contiguous allocation with the paged pool and its PEAK live
+    pages (the memory actually needed). ``--page-size=N`` tunes the
+    page (default 16 CPU / 64 TPU); ``--kv-dtype=int8`` stores int8
+    pools with per-(page, head) scales (the parity assert relaxes to
+    a match-count report; keys gain an ``_int8`` suffix).
+    ``--shared-prefix`` switches to the shared-system-prompt workload
+    and A/Bs paged+prefix-sharing against plain paged: same tokens,
+    ``prefix_hits``/``shared_page_ratio`` > 0, and the TTFT p95 win
+    reports under ``gpt_serve_ttft_ms_shared_prefix``."""
     from rocm_apex_tpu.inference import InferenceEngine, SamplingParams
 
     on_tpu = jax.default_backend() == "tpu"
@@ -398,12 +415,29 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )
     rng = np.random.RandomState(0)
-    prompts = [
-        rng.randint(
-            0, cfg.vocab_size, size=int(rng.choice(lens, p=probs))
-        ).tolist()
-        for _ in range(n_requests)
-    ]
+    if shared_prefix:
+        # shared-system-prompt traffic (the millions-of-users regime:
+        # most tokens of most requests are the same tokens): one fixed
+        # prefix + a short random tail per request. The length is NOT
+        # page-aligned on purpose: the tail's first tokens land inside
+        # the last shared page, so the A/B also exercises the partial
+        # borrow -> copy-on-write fork path
+        prefix_len = 250 if on_tpu else 60
+        prefix = rng.randint(0, cfg.vocab_size, size=prefix_len).tolist()
+        prompts = [
+            prefix
+            + rng.randint(
+                0, cfg.vocab_size, size=int(rng.randint(4, 17))
+            ).tolist()
+            for _ in range(n_requests)
+        ]
+    else:
+        prompts = [
+            rng.randint(
+                0, cfg.vocab_size, size=int(rng.choice(lens, p=probs))
+            ).tolist()
+            for _ in range(n_requests)
+        ]
     total_prompt = sum(len(p) for p in prompts)
 
     def build(chunked, tracer=None):
@@ -429,6 +463,141 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
         dt = time.perf_counter() - t0
         gen = sum(len(r.tokens) for r in results)
         return eng, results, gen / dt, dt
+
+    if paged or shared_prefix:
+        kv = jnp.int8 if kv_dtype == "int8" else None
+        ps = page_size or (64 if on_tpu else 16)
+        suffix = "_int8" if kv is not None else ""
+
+        def build_paged(sharing):
+            return InferenceEngine(
+                model, params, num_slots=num_slots, capacity=capacity,
+                sampling=SamplingParams(temperature=0.0), seed=0,
+                prefill_token_budget=budget, paged=True, page_size=ps,
+                kv_dtype=kv, prefix_sharing=sharing,
+            )
+
+        def run_steps(eng):
+            # warmup compiles on the same engine; under prefix sharing
+            # it ALSO registers the shared prefix, so the timed window
+            # measures steady-state serving (warm store). The second
+            # tiny pass replays a TRUNCATED first prompt that ends
+            # INSIDE a stored page (the prefix is not page-aligned):
+            # partial borrow -> the copy-on-write fork program
+            # compiles here, not in the timed window
+            eng.generate(prompts[:num_slots], max_new_tokens=3)
+            if eng.prefix_sharing and shared_prefix:
+                eng.generate(
+                    [prompts[0][:prefix_len + 2]], max_new_tokens=3
+                )
+            eng.reset_stats()
+            ids = [
+                eng.add_request(p, max_new_tokens=max_new)
+                for p in prompts
+            ]
+            done = {}
+            peak_pages = 0
+            t0 = time.perf_counter()
+            while eng.has_work():
+                for r in eng.step():
+                    done[r.request_id] = r
+                if eng.paged:
+                    peak_pages = max(
+                        peak_pages, int(eng.stats()["pages_used"])
+                    )
+            dt = time.perf_counter() - t0
+            results = [done[i] for i in ids]
+            gen = sum(len(r.tokens) for r in results)
+            return eng, results, gen / dt, dt, eng.stats(), peak_pages
+
+        if shared_prefix:
+            _, res_b, tok_b, dt_b, s_b, _ = run_steps(build_paged(False))
+            _, res_s, tok_s, dt_s, s_s, _ = run_steps(build_paged(True))
+            # sharing maps the SAME materialized pages a private
+            # prefill would have produced — tokens must not move
+            for rb, rs in zip(res_b, res_s):
+                assert rb.tokens == rs.tokens, (
+                    f"prefix sharing changed tokens on request "
+                    f"{rs.request_id}"
+                )
+            assert s_s["prefix_hits"] > 0, "no prefix hits measured"
+            for mode, tk, dt, s in (
+                ("paged", tok_b, dt_b, s_b),
+                ("paged+shared", tok_s, dt_s, s_s),
+            ):
+                print(
+                    f"serve[{mode}{suffix}]: {tk:.1f} gen tok/s over "
+                    f"{dt:.2f}s ttft p95={s['ttft_ms_p95']:.0f}ms "
+                    f"prefix_hits={s['prefix_hits']:.0f} "
+                    f"hit_tokens={s['prefix_hit_tokens']:.0f} "
+                    f"cow_forks={s['cow_forks']:.0f}",
+                    file=sys.stderr,
+                )
+            _report(
+                f"gpt_serve_tokens_per_sec_per_chip_shared_prefix{suffix}",
+                tok_s, "tokens/s", tok_s / tok_b,
+                f"prefix sharing {tok_s:.1f} vs plain paged "
+                f"{tok_b:.1f} tok/s; {s_s['prefix_hit_tokens']:.0f} "
+                f"prompt tokens never re-prefilled; tokens identical",
+            )
+            _report(
+                f"gpt_serve_ttft_ms_shared_prefix{suffix}",
+                s_s["ttft_ms_p95"], "ms",
+                s_b["ttft_ms_p95"] / max(s_s["ttft_ms_p95"], 1e-9),
+                f"ttft p95: shared {s_s['ttft_ms_p95']:.0f} ms vs "
+                f"plain paged {s_b['ttft_ms_p95']:.0f} ms "
+                f"(ratio = vs_baseline)",
+            )
+            return
+
+        # plain paged A/B against the contiguous chunked engine
+        eng_c, res_c, tok_c, dt_c = run(True)
+        s_c = eng_c.stats()
+        eng_p, res_p, tok_p, dt_p, s_p, peak = run_steps(
+            build_paged(False)
+        )
+        if kv is None:
+            for rc, rp in zip(res_c, res_p):
+                assert rc.tokens == rp.tokens, (
+                    f"paged/contiguous token mismatch on request "
+                    f"{rp.request_id}"
+                )
+            parity = "tokens identical"
+        else:
+            same = sum(
+                rc.tokens == rp.tokens for rc, rp in zip(res_c, res_p)
+            )
+            parity = f"int8 greedy match {same}/{len(res_c)} requests"
+        cont_bytes = eng_c.cache_bytes()
+        pool_bytes = eng_p.cache_bytes()
+        num_pages = eng_p.cache.num_pages
+        live_bytes = int(pool_bytes * peak / max(num_pages, 1))
+        mb = 1.0 / (1024 * 1024)
+        print(
+            f"serve[paged{suffix}]: {tok_p:.1f} gen tok/s over "
+            f"{dt_p:.2f}s (page_size={ps}) vs contiguous {tok_c:.1f}; "
+            f"cache bytes: contiguous {cont_bytes*mb:.2f} MiB, paged "
+            f"pool {pool_bytes*mb:.2f} MiB, peak LIVE "
+            f"{live_bytes*mb:.2f} MiB ({peak}/{num_pages} pages) — "
+            f"{parity}",
+            file=sys.stderr,
+        )
+        _report(
+            f"gpt_serve_tokens_per_sec_per_chip_paged{suffix}",
+            tok_p, "tokens/s", tok_p / tok_c,
+            f"paged {tok_p:.1f} vs contiguous {tok_c:.1f} tok/s; "
+            f"{parity}; peak live cache {live_bytes*mb:.2f} MiB vs "
+            f"contiguous {cont_bytes*mb:.2f} MiB",
+        )
+        _report(
+            f"gpt_serve_ttft_ms_paged{suffix}",
+            s_p["ttft_ms_p95"], "ms",
+            s_c["ttft_ms_p95"] / max(s_p["ttft_ms_p95"], 1e-9),
+            f"ttft p95: paged {s_p['ttft_ms_p95']:.0f} ms vs "
+            f"contiguous {s_c['ttft_ms_p95']:.0f} ms "
+            f"(ratio = vs_baseline)",
+        )
+        return
 
     # --trace instruments the MEASURED mode (chunked, or whole under
     # --whole-prompt) — the A/B contrast numbers stay tracer-free
@@ -1105,6 +1274,14 @@ if __name__ == "__main__":
             kwargs["whole_prompt"] = True
         elif a.startswith("--trace="):
             kwargs["trace"] = a.split("=", 1)[1]
+        elif a == "--paged":
+            kwargs["paged"] = True
+        elif a.startswith("--page-size="):
+            kwargs["page_size"] = int(a.split("=", 1)[1])
+        elif a.startswith("--kv-dtype="):
+            kwargs["kv_dtype"] = a.split("=", 1)[1]
+        elif a == "--shared-prefix":
+            kwargs["shared_prefix"] = True
         elif a.startswith("--fused="):
             kwargs["fused"] = bool(int(a.split("=", 1)[1]))
         elif a.startswith("--"):
@@ -1135,10 +1312,24 @@ if __name__ == "__main__":
         )
     if (
         "budget" in kwargs or "whole_prompt" in kwargs
-        or "trace" in kwargs
+        or "trace" in kwargs or "paged" in kwargs
+        or "page_size" in kwargs or "kv_dtype" in kwargs
+        or "shared_prefix" in kwargs
     ) and which != "serve":
         raise SystemExit(
-            "--budget/--whole-prompt/--trace apply to the serve bench"
+            "--budget/--whole-prompt/--trace/--paged/--page-size/"
+            "--kv-dtype/--shared-prefix apply to the serve bench"
+        )
+    if kwargs.get("kv_dtype") not in (None, "int8"):
+        raise SystemExit(
+            f"--kv-dtype={kwargs['kv_dtype']!r}: only int8 is a "
+            "quantized cache dtype (omit the flag for the model dtype)"
+        )
+    if (
+        "page_size" in kwargs or "kv_dtype" in kwargs
+    ) and not (kwargs.get("paged") or kwargs.get("shared_prefix")):
+        raise SystemExit(
+            "--page-size/--kv-dtype require --paged (or --shared-prefix)"
         )
     if "fused" in kwargs and which != "rn50":
         raise SystemExit("--fused applies to the rn50 bench")

@@ -6,13 +6,19 @@ nothing is forked:
 
     kv_cache   preallocated slot-paged KV cache pytree (bf16 default,
                in-place dynamic_update_slice writes, per-slot lengths)
+    paging     vLLM-style paged cache: shared page pool + per-slot
+               block tables (`PagedKVCache`), host free-list/ref-count
+               `PageAllocator`, and the copy-on-write `PrefixStore`
+               that shares materialized prompt pages across requests;
+               optional int8 pools with per-(page, head) scales
     sampling   greedy / temperature / top-k / top-p, jit-able and
                seed-deterministic
     engine     continuous-batching serving loop: fixed slot grid,
                request queue, per-step admit/evict, and the chunked-
                prefill token-budget scheduler — ONE compiled mixed
                chunk+decode step per tick (plus a decode-only fast
-               path), donated cache buffers, no prompt-length ceiling
+               path), donated cache buffers, no prompt-length ceiling;
+               ``paged=True`` swaps in the block-table cache
 
 The model side lives in `models/gpt.py` (``cache=`` on `GPTModel`) and
 `ops/flash_attention.py` (`flash_attention_decode`); this package owns
@@ -26,6 +32,11 @@ from rocm_apex_tpu.inference.engine import (  # noqa: F401
     SamplingParams,
 )
 from rocm_apex_tpu.inference.kv_cache import KVCache  # noqa: F401
+from rocm_apex_tpu.inference.paging import (  # noqa: F401
+    PageAllocator,
+    PagedKVCache,
+    PrefixStore,
+)
 from rocm_apex_tpu.inference.sampling import (  # noqa: F401
     greedy,
     sample,
@@ -35,6 +46,9 @@ from rocm_apex_tpu.inference.sampling import (  # noqa: F401
 
 __all__ = [
     "KVCache",
+    "PagedKVCache",
+    "PageAllocator",
+    "PrefixStore",
     "InferenceEngine",
     "Request",
     "GenerationResult",

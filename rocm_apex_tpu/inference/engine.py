@@ -48,7 +48,7 @@ order regardless of wall-clock timing.
 import collections
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Set
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,11 @@ import numpy as np
 
 from rocm_apex_tpu import profiler
 from rocm_apex_tpu.inference.kv_cache import KVCache
+from rocm_apex_tpu.inference.paging import (
+    PageAllocator,
+    PagedKVCache,
+    PrefixStore,
+)
 from rocm_apex_tpu.inference.sampling import sample
 from rocm_apex_tpu.monitor.trace import NULL_TRACER
 from rocm_apex_tpu.ops._pallas import on_tpu
@@ -111,6 +116,13 @@ class _Slot:
     leased_at: float = 0.0
     first_token_at: float = 0.0
     chunks: int = 0
+    # paged-cache bookkeeping (engine-paged mode only): page indices
+    # this slot BORROWS from the prefix store (immutable until a
+    # copy-on-write fork), the chain key of the last full prompt page
+    # walked/registered, and how many full prompt pages that is.
+    borrowed: Set[int] = dataclasses.field(default_factory=set)
+    chain_key: Any = None
+    reg_pages: int = 0
 
     @property
     def prefilling(self) -> bool:
@@ -150,6 +162,23 @@ class InferenceEngine:
     tokens, chunks, queue wait) accrue on ``completions``
     unconditionally — pure host bookkeeping.
 
+    ``paged=True`` swaps the contiguous per-slot cache for the
+    block-table `PagedKVCache` (chunked scheduler required): device
+    memory in use scales with LIVE tokens, writes scatter through the
+    page table and reads gather through it
+    (`flash_attention_decode_paged`). ``page_size`` tunes the
+    fragmentation/indirection trade; ``num_pages`` caps the pool
+    (default: worst-case slots × pages_per_slot — size it DOWN to
+    realize the memory win; exhaustion backpressures token scheduling,
+    it never crashes). ``kv_dtype=jnp.int8`` stores int8 pools with
+    per-(page, head) fp32 scales (~half the cache bytes and decode
+    DMA; greedy outputs stay parity-grade, see tests).
+    ``prefix_sharing=True`` additionally ref-counts fully-written
+    prompt pages in a `PrefixStore`: a later request with the same
+    prompt prefix maps those pages instead of re-prefilling them
+    (TTFT collapses for shared-system-prompt traffic) and pages fork
+    copy-on-write only when the borrower would write into one.
+
     Single-chip (tp=1) in this PR; the cache layout already stores
     LOCAL head shards, so multi-chip sharded serving is a cache-
     compatible follow-up.
@@ -170,6 +199,11 @@ class InferenceEngine:
         prefill_token_budget: Optional[int] = 64,
         prefill_chunk: Optional[int] = None,
         tracer=None,
+        paged: bool = False,
+        page_size: int = 16,
+        kv_dtype: Any = None,
+        num_pages: Optional[int] = None,
+        prefix_sharing: bool = False,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -209,9 +243,56 @@ class InferenceEngine:
             )
         self.eos_id = eos_id
         self.sampling = sampling or SamplingParams()
-        self.cache = KVCache.for_model(
-            cfg, num_slots, self.capacity, dtype=cache_dtype
-        )
+        self.paged = bool(paged)
+        self.prefix_sharing = bool(prefix_sharing)
+        self._allocator = None
+        self._store = None
+        self._cow_forks = 0
+        self._prefix_hits = 0
+        self._prefix_hit_tokens = 0
+        self._page_stalls = 0
+        if not self.paged:
+            if prefix_sharing:
+                raise ValueError("prefix_sharing requires paged=True")
+            if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
+                raise ValueError("kv_dtype=int8 requires paged=True")
+            self.cache = KVCache.for_model(
+                cfg, num_slots, self.capacity, dtype=cache_dtype
+            )
+        else:
+            if self.prefill_token_budget is None:
+                raise ValueError(
+                    "the paged cache serves the chunked-prefill "
+                    "scheduler only (the legacy whole-prompt path "
+                    "needs contiguous slot rows); set "
+                    "prefill_token_budget"
+                )
+            quantized = (
+                kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8
+            )
+            self.cache = PagedKVCache.for_model(
+                cfg, num_slots, self.capacity,
+                page_size=page_size, num_pages=num_pages,
+                dtype=(
+                    kv_dtype if (kv_dtype is not None and not quantized)
+                    else cache_dtype
+                ),
+                quantized=quantized,
+            )
+            self._allocator = PageAllocator(self.cache.num_pages)
+            if prefix_sharing:
+                self._store = PrefixStore(page_size)
+                self._allocator.on_evict = self._store.unregister_page
+            # host mirror of the page table (the host is the source of
+            # truth; pushed to device once per tick when dirty)
+            self._table = np.full(
+                (num_slots, self.cache.pages_per_slot),
+                self.cache.num_pages, np.int32,
+            )
+            self._table_dirty = False
+            self._fork_jit = jax.jit(
+                lambda cache, src, dst: cache.fork_page(src, dst)
+            )
         self._rng = jax.random.PRNGKey(seed)
         self._queue: collections.deque = collections.deque()
         self._slots: List[Optional[_Slot]] = [None] * num_slots
@@ -271,16 +352,34 @@ class InferenceEngine:
             first_tok = _sample(rng, last[None, :])[0]
             return first_tok, cache
 
+        is_paged = self.paged
+        dev_capacity = self.cache.capacity
+
         def _decode_body(params, cache, tokens, active, rng):
+            lengths0 = cache.lengths
+            if is_paged:
+                # dead rows write at the device capacity sentinel: the
+                # paged scatter DROPS the write (a contiguous cache
+                # tolerates dead-row junk because the next prefill
+                # overwrites it, but a paged junk write could land in
+                # a live — even SHARED — page, and under int8 would
+                # inflate that page's running scale)
+                cache = cache.replace(
+                    lengths=jnp.where(
+                        active, lengths0,
+                        jnp.full_like(lengths0, dev_capacity),
+                    )
+                )
             logits, new_cache = model.apply(
                 params, tokens[:, None], cache=cache
             )
-            # pin inactive slots' lengths (their dead-row writes land
-            # in junk the next prefill overwrites, but unbounded drift
-            # would saturate the clamp)
+            # pin inactive slots' lengths (their dead-row writes drop
+            # (paged) or land in junk the next prefill overwrites
+            # (contiguous), but unbounded drift would saturate the
+            # clamp)
             new_cache = new_cache.replace(
                 lengths=jnp.where(
-                    active, new_cache.lengths, cache.lengths
+                    active, new_cache.lengths, lengths0
                 )
             )
             tok = _sample(rng, logits[:, -1, :])
@@ -408,7 +507,17 @@ class InferenceEngine:
         wall time. Per-request distributions: ``queue_wait_ms_p50/95``
         (enqueue → slot lease) and ``ttft_ms_p50/95`` (enqueue →
         first token) — the tails that surface head-of-line blocking,
-        which the averages above hide."""
+        which the averages above hide.
+
+        Paged-cache occupancy (zeros on the contiguous engine):
+        ``pages_total``/``pages_used``/``page_occupancy`` (pages
+        holding a live mapping — THE memory-win witness: it scales
+        with live tokens, not slots × capacity), ``shared_page_ratio``
+        (mapped table entries pointing at ref>1 pages),
+        ``cow_forks``, ``prefix_hits``/``prefix_hit_tokens`` (admits
+        that skipped re-prefilling a stored prefix, and the tokens
+        skipped), ``page_stalls`` (tokens deferred by pool
+        backpressure)."""
         prefill_ticks = (
             self._mixed_steps if self.chunked else self._admitted
         )
@@ -425,7 +534,36 @@ class InferenceEngine:
         def _pct(values, q):
             return float(np.percentile(values, q)) if values else 0.0
 
+        # page-occupancy counters (zeros when not paged, so one
+        # MetricsLogger schema serves both engines)
+        pages_total = float(self.cache.num_pages) if self.paged else 0.0
+        pages_used = (
+            float(self._allocator.pages_used) if self.paged else 0.0
+        )
+        shared_ratio = 0.0
+        if self.paged:
+            sentinel = self.cache.num_pages
+            mapped = self._table[self._table != sentinel]
+            if mapped.size:
+                shared = sum(
+                    1 for p in mapped
+                    if self._allocator.refcount(int(p)) > 1
+                )
+                shared_ratio = shared / mapped.size
+        paged_stats = {
+            "pages_total": pages_total,
+            "pages_used": pages_used,
+            "page_occupancy": (
+                pages_used / pages_total if pages_total else 0.0
+            ),
+            "shared_page_ratio": shared_ratio,
+            "cow_forks": float(self._cow_forks),
+            "prefix_hits": float(self._prefix_hits),
+            "prefix_hit_tokens": float(self._prefix_hit_tokens),
+            "page_stalls": float(self._page_stalls),
+        }
         return {
+            **paged_stats,
             "queue_depth": float(self.num_queued),
             "slots_active": float(self.num_active),
             "slot_occupancy": self.num_active / self.num_slots,
@@ -467,6 +605,20 @@ class InferenceEngine:
         self._queue_waits = []
         self._ttfts = []
         self._completions = []
+        self._cow_forks = 0
+        self._prefix_hits = 0
+        self._prefix_hit_tokens = 0
+        self._page_stalls = 0
+
+    def cache_bytes(self) -> int:
+        """Device bytes held by the KV cache (pools/buffers + scales +
+        tables + lengths — every leaf of the cache pytree). The paged
+        A/B's memory line: contiguous = slots × capacity rows up
+        front; paged = the page pool you sized (int8 ~halves it)."""
+        return sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(self.cache)
+        )
 
     def add_request(
         self,
@@ -544,18 +696,178 @@ class InferenceEngine:
     # internals
     # ------------------------------------------------------------------
 
+    # -- paged-cache host bookkeeping ----------------------------------
+
+    def _page_registered(self, page: int) -> bool:
+        return self._store is not None and self._store.is_registered(page)
+
+    def _map_page(self, slot: int, idx: int, page: int) -> None:
+        self._table[slot, idx] = page
+        self._table_dirty = True
+
+    def _push_table(self) -> None:
+        """Sync the host page-table mirror to the device pytree (once
+        per tick, only when the mapping changed)."""
+        if self._table_dirty:
+            self.cache = self.cache.replace(
+                page_table=jnp.asarray(self._table)
+            )
+            self._table_dirty = False
+
+    def _ensure_writable(self, st: _Slot, slot: int, idx: int) -> bool:
+        """Page index ``idx`` of ``slot`` is mapped and privately
+        owned after this call — allocating a fresh page for an
+        unmapped entry, or copy-on-write-forking a BORROWED
+        (prefix-shared) page the slot is about to write into. Returns
+        False when the pool cannot supply a page: the caller
+        backpressures (the token simply is not scheduled this tick;
+        nothing crashes, nothing clamps)."""
+        sentinel = self.cache.num_pages
+        page = int(self._table[slot, idx])
+        track = f"req{st.req.request_id}"
+        if page == sentinel:
+            got = self._allocator.alloc(1)
+            if got is None:
+                return False
+            self._map_page(slot, idx, got[0])
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "page_alloc", track=track,
+                    page=got[0], page_idx=idx, slot=slot,
+                )
+            return True
+        if idx in st.borrowed:
+            got = self._allocator.alloc(1)
+            if got is None:
+                return False
+            dst = got[0]
+            # device copy first (one compiled program for every fork),
+            # then remap: the sharers keep reading the source page —
+            # their bytes are never touched
+            self.cache = self._fork_jit(
+                self.cache, jnp.int32(page), jnp.int32(dst)
+            )
+            self._allocator.decref(page, park=self._page_registered(page))
+            st.borrowed.discard(idx)
+            self._map_page(slot, idx, dst)
+            self._cow_forks += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "cow_fork", track=track,
+                    src=page, dst=dst, page_idx=idx, slot=slot,
+                )
+        return True
+
+    def _secure_prefill_pages(self, st: _Slot, slot: int, n: int) -> int:
+        """Make pages for prompt positions ``[cursor, cursor + n)``
+        writable; returns how many of the n tokens actually have a
+        page (possibly 0 — free-list exhaustion backpressure)."""
+        ps = self.cache.page_size
+        secured_end = st.cursor
+        first = st.cursor // ps
+        last = (st.cursor + n - 1) // ps
+        for idx in range(first, last + 1):
+            if not self._ensure_writable(st, slot, idx):
+                self._page_stalls += 1
+                break
+            secured_end = min(st.cursor + n, (idx + 1) * ps)
+        return secured_end - st.cursor
+
+    def _register_full_pages(self, st: _Slot, slot: int) -> None:
+        """Advance the slot's prefix chain over every page that is now
+        FULL of prompt tokens: freshly-owned pages register in the
+        store (immutable from here on — appends only land past them);
+        borrowed pages just advance the chain key they were matched
+        from."""
+        ps = self.cache.page_size
+        prompt = st.req.prompt
+        while ((st.reg_pages + 1) * ps <= st.cursor
+               and (st.reg_pages + 1) * ps <= len(prompt)):
+            idx = st.reg_pages
+            tokens = prompt[idx * ps:(idx + 1) * ps]
+            if idx in st.borrowed:
+                st.chain_key = self._store.chain_key(
+                    st.chain_key, tokens
+                )
+            else:
+                st.chain_key = self._store.register(
+                    st.chain_key, tokens, int(self._table[slot, idx])
+                )
+            st.reg_pages += 1
+
+    def _release_slot_pages(self, st: _Slot, slot: int) -> None:
+        """Eviction: drop this slot's page references. Store-registered
+        pages PARK (reclaimable prefix cache — a later request with
+        the same prefix revives them for free); private pages free."""
+        sentinel = self.cache.num_pages
+        for idx in range(self._table.shape[1]):
+            page = int(self._table[slot, idx])
+            if page == sentinel:
+                continue
+            self._allocator.decref(
+                page, park=self._page_registered(page)
+            )
+            self._table[slot, idx] = sentinel
+        self._table_dirty = True
+        st.borrowed.clear()
+
+    def _guard_capacity(self, active) -> None:
+        """The host-side replacement for the cache's silent
+        clamp-at-capacity: a live slot about to DECODE at a position
+        >= capacity is an engine invariant violation (the scheduler
+        must have evicted it with finish_reason='capacity' already) —
+        raise with the slot id instead of wedging the length and
+        silently re-sampling from a stale last row."""
+        for slot, st in enumerate(self._slots):
+            if st is None or not active[slot]:
+                continue
+            if st.pos >= self.capacity:
+                raise RuntimeError(
+                    f"slot {slot} (request {st.req.request_id}) would "
+                    f"write cache position {st.pos} >= capacity "
+                    f"{self.capacity}: the engine must evict a "
+                    f"sequence before its length hits capacity "
+                    f"(finish_reason='capacity'), never clamp a live "
+                    f"write"
+                )
+
     def _admit_free_slots(self, now: float) -> None:
         """Lease free slots to queued requests (host bookkeeping; the
-        prefill work itself is scheduled by the caller)."""
+        prefill work itself is scheduled by the caller). With prefix
+        sharing, a prompt that extends an already-materialized page
+        chain maps those pages by REFERENCE and starts its prefill
+        cursor past them — the shared tokens are never re-prefilled."""
         for slot in range(self.num_slots):
             if self._slots[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
             self._admitted += 1
             self._queue_waits.append(now - req.enqueued_at)
-            self._slots[slot] = _Slot(
+            st = _Slot(
                 req=req, generated=[], pos=0, cursor=0, leased_at=now
             )
+            self._slots[slot] = st
+            if self._store is not None:
+                pages, matched, partial, key = self._store.match(
+                    req.prompt
+                )
+                if matched > 0:
+                    for idx, page in enumerate(pages):
+                        self._allocator.ref(page)
+                        self._map_page(slot, idx, page)
+                        st.borrowed.add(idx)
+                    st.cursor = matched
+                    st.pos = matched
+                    st.chain_key = key
+                    st.reg_pages = len(pages) - (1 if partial else 0)
+                    self._prefix_hits += 1
+                    self._prefix_hit_tokens += matched
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "prefix_hit", track=f"req{req.request_id}",
+                            tokens=matched, pages=len(pages),
+                            partial_tokens=partial, slot=slot,
+                        )
             if self.tracer.enabled:
                 self.tracer.add_span(
                     "queue_wait", req.enqueued_at, now,
@@ -576,7 +888,8 @@ class InferenceEngine:
         chunk_pos = np.zeros((budget,), np.int32)
         lengths_before = np.zeros((S,), np.int32)
         lengths_after = np.zeros((S,), np.int32)
-        completions = []  # (slot, chunk index of the last prompt token)
+        # (slot, chunk index of last prompt token, fed-to-decode flag)
+        completions = []
         packed = []  # (slot, tokens, start_pos) — tracer span payload
         used = 0
         # slot order keeps the packed segment ids non-decreasing (the
@@ -591,6 +904,13 @@ class InferenceEngine:
             n = min(budget - used, len(st.req.prompt) - st.cursor)
             if self.prefill_chunk is not None:
                 n = min(n, self.prefill_chunk)
+            if self.paged:
+                # pool backpressure: only tokens whose pages exist (or
+                # could be allocated / CoW-forked) are scheduled; a
+                # starved slot just waits for evictions to free pages
+                n = self._secure_prefill_pages(st, slot, n)
+                if n <= 0:
+                    continue
             chunk_tokens[used:used + n] = st.req.prompt[
                 st.cursor:st.cursor + n
             ]
@@ -604,8 +924,24 @@ class InferenceEngine:
             st.chunks += 1
             lengths_after[slot] = st.cursor
             self._prompt_tokens += n
+            if self.paged and self._store is not None:
+                self._register_full_pages(st, slot)
             if not st.prefilling:
-                completions.append((slot, used + n - 1))
+                # the completing prompt's first sampled token is fed
+                # straight into the fused decode — UNLESS that decode
+                # write has nowhere to land: a prompt that exactly
+                # fills capacity (the old silent clamp-at-capacity; the
+                # host evicts it right after the first token instead)
+                # or a paged slot whose next page the pool cannot
+                # supply yet (it decodes on a later tick)
+                fed = st.cursor < self.capacity
+                if fed and self.paged:
+                    fed = self._ensure_writable(
+                        st, slot, st.cursor // self.cache.page_size
+                    )
+                    if not fed:
+                        self._page_stalls += 1
+                completions.append((slot, used + n - 1, fed))
             used += n
 
         # decode grid: slots whose prompt completed in an EARLIER tick
@@ -615,6 +951,19 @@ class InferenceEngine:
             [s is not None and bool(s.generated) for s in self._slots],
             dtype=bool,
         )
+        self._guard_capacity(active)
+        if self.paged:
+            for slot, st in enumerate(self._slots):
+                if not active[slot]:
+                    continue
+                if not self._ensure_writable(
+                    st, slot, st.pos // self.cache.page_size
+                ):
+                    # stall THIS slot's decode for the tick; everyone
+                    # else advances (fixed shapes: the row just rides
+                    # along dead)
+                    active[slot] = False
+                    self._page_stalls += 1
         dec_tokens = np.array(
             [s.generated[-1] if s is not None and s.generated else 0
              for s in self._slots],
@@ -622,8 +971,23 @@ class InferenceEngine:
         )
 
         completion_idx = np.full((S,), -1, np.int32)
-        for slot, idx in completions:
-            completion_idx[slot] = idx
+        for slot, idx, fed in completions:
+            completion_idx[slot] = idx if fed else -1
+        if self.paged:
+            self._push_table()
+            if (
+                used == 0 and not active.any() and completions == []
+                and self.has_work()
+            ):
+                raise RuntimeError(
+                    "paged KV pool deadlock: every in-flight request "
+                    "is stalled waiting for pages and no decode can "
+                    "run to free any (pages="
+                    f"{self.cache.num_pages}, used="
+                    f"{self._allocator.pages_used}); size num_pages "
+                    "for the expected live tokens, or admit less "
+                    "concurrency"
+                )
 
         chunk_out = None
         dec_out = None
@@ -683,7 +1047,7 @@ class InferenceEngine:
                 )
 
         now2 = time.perf_counter()
-        for slot, idx in completions:
+        for slot, idx, fed in completions:
             st = self._slots[slot]
             st.generated.append(int(chunk_out[idx]))
             self._generated_tokens += 1
@@ -691,9 +1055,14 @@ class InferenceEngine:
             self._ttfts.append(now2 - st.req.enqueued_at)
             done = self._finish_reason(st)
             if done is not None:
-                # the fused decode already ran for this slot; its
-                # output is discarded with the eviction (dead-row junk)
+                # any fused decode output for this slot is discarded
+                # with the eviction (dead-row junk)
                 finished.append(self._evict(slot, st, done))
+                continue
+            if not fed:
+                # no fused decode ran for this slot (at-capacity edge
+                # already evicted above, or a paged page stall): the
+                # second token arrives on a later tick
                 continue
             # the mixed step fed the first token straight into the
             # decode grid: the SECOND token arrives in the same tick
@@ -778,6 +1147,7 @@ class InferenceEngine:
         active = np.array(
             [s is not None for s in self._slots], dtype=bool
         )
+        self._guard_capacity(active)
         if active.any():
             tokens = np.array(
                 [s.generated[-1] if s is not None else 0
@@ -826,6 +1196,8 @@ class InferenceEngine:
     ) -> GenerationResult:
         self._slots[slot] = None
         self._evicted += 1
+        if self.paged:
+            self._release_slot_pages(state, slot)
         finished_at = time.perf_counter()
         req = state.req
         n_new = len(state.generated)

@@ -161,10 +161,16 @@ class KVCache:
 
     def advance(self, t: int, active: Optional[jnp.ndarray] = None
                 ) -> "KVCache":
-        """Advance lengths by ``t`` (clamped to capacity; the engine
-        must finish a sequence BEFORE its length hits capacity — the
-        clamp only keeps stale/idle slots from drifting out of
-        bounds). ``active`` masks which slots advance."""
+        """Advance lengths by ``t``, clamped to capacity. The clamp
+        exists ONLY to keep stale/idle slots from drifting out of
+        bounds — it is not a liveness mechanism: the engine evicts a
+        sequence before its length hits capacity
+        (``finish_reason='capacity'``), suppresses the fused decode of
+        a prompt that exactly fills capacity, and RAISES a host-side
+        error (with the slot id) if a live slot ever reaches the clamp
+        (`InferenceEngine._guard_capacity`) — a silently wedged length
+        would re-attend a stale last row forever. ``active`` masks
+        which slots advance."""
         new = jnp.minimum(self.lengths + t, self.capacity)
         if active is not None:
             new = jnp.where(active, new, self.lengths)

@@ -488,27 +488,62 @@ class ParallelAttention(nn.Module):
             # intra-chunk causality. `chunk` = (slot_ids, positions),
             # both (budget,) int32; x is the (1, budget, h) packed
             # stream; padding tokens carry slot id == num_slots.
+            # `cache` is the 3-tuple contiguous layer view, or the
+            # 4-tuple paged view whose last element carries the page
+            # table / page size / per-(page, head) int8 scales — the
+            # writes scatter through the table and the reads gather
+            # through it (ops/paging.py + the paged flash kernels).
             if x.shape[0] != 1:
                 raise ValueError(
                     "chunked prefill takes one packed stream "
                     f"(batch 1), got batch {x.shape[0]}"
                 )
-            k_buf, v_buf, lengths = cache
+            k_buf, v_buf, lengths = cache[:3]
+            paged = cache[3] if len(cache) > 3 else None
             chunk_slots, chunk_pos = chunk
-            num_slots, capacity = k_buf.shape[0], k_buf.shape[1]
             budget = x.shape[1]
             q, k, v = jnp.split(qkv, 3, axis=-1)  # (1, budget, nh, hd)
             qq, kq, vq = q[0], k[0], v[0]  # (budget, nh, hd)
-            # scatter this chunk's K/V at per-token (slot, position)
-            # destinations (in place under jit with donated buffers);
-            # out-of-range pad slots are dropped by the scatter
-            k_buf = k_buf.at[chunk_slots, chunk_pos].set(
-                kq.astype(k_buf.dtype), mode="drop"
-            )
-            v_buf = v_buf.at[chunk_slots, chunk_pos].set(
-                vq.astype(v_buf.dtype), mode="drop"
-            )
-            new_kv = (k_buf, v_buf)
+            k_sc = v_sc = None
+            if paged is None:
+                num_slots, capacity = k_buf.shape[0], k_buf.shape[1]
+                # scatter this chunk's K/V at per-token (slot, position)
+                # destinations (in place under jit with donated
+                # buffers); out-of-range pad slots are dropped
+                k_buf = k_buf.at[chunk_slots, chunk_pos].set(
+                    kq.astype(k_buf.dtype), mode="drop"
+                )
+                v_buf = v_buf.at[chunk_slots, chunk_pos].set(
+                    vq.astype(v_buf.dtype), mode="drop"
+                )
+                new_kv = (k_buf, v_buf)
+            else:
+                from rocm_apex_tpu.ops.paging import (
+                    paged_scatter,
+                    quantized_paged_scatter,
+                )
+
+                table = paged["page_table"]
+                num_slots = table.shape[0]
+                capacity = table.shape[1] * paged["page_size"]
+                if paged["k_scale"] is not None:
+                    k_buf, k_sc = quantized_paged_scatter(
+                        k_buf, paged["k_scale"], table,
+                        chunk_slots, chunk_pos, kq,
+                    )
+                    v_buf, v_sc = quantized_paged_scatter(
+                        v_buf, paged["v_scale"], table,
+                        chunk_slots, chunk_pos, vq,
+                    )
+                    new_kv = (k_buf, v_buf, k_sc, v_sc)
+                else:
+                    k_buf = paged_scatter(
+                        k_buf, table, chunk_slots, chunk_pos, kq
+                    )
+                    v_buf = paged_scatter(
+                        v_buf, table, chunk_slots, chunk_pos, vq
+                    )
+                    new_kv = (k_buf, v_buf)
             slot_c = jnp.clip(chunk_slots, 0, num_slots - 1)
             if cfg.attention_impl == "jnp":
                 # one-pass reference: the chunk K/V are already in the
@@ -519,14 +554,25 @@ class ParallelAttention(nn.Module):
                 # a per-token gather: k_buf[slots] would materialize
                 # (budget, capacity, heads, hd) — each slot's cache
                 # duplicated once per chunk token (measured as most of
-                # the mixed-tick cost on the CPU serve bench)
+                # the mixed-tick cost on the CPU serve bench). A paged
+                # cache reads the table-gathered contiguous view
+                # (dequantized when int8) — byte-identical rows when
+                # unquantized, so paged-vs-contiguous parity is exact
+                # on this path.
+                if paged is None:
+                    kc_read, vc_read = k_buf, v_buf
+                else:
+                    from rocm_apex_tpu.ops.paging import paged_view
+
+                    kc_read = paged_view(k_buf, table, scale=k_sc)
+                    vc_read = paged_view(v_buf, table, scale=v_sc)
                 onehot = (
                     slot_c[:, None] == jnp.arange(num_slots)[None, :]
                 ).astype(jnp.float32)  # (budget, num_slots)
                 scores = jnp.einsum(
                     "tnd,scnd,ts->tnc",
                     qq.astype(jnp.float32),
-                    k_buf.astype(jnp.float32),
+                    kc_read.astype(jnp.float32),
                     onehot,
                 ) * scale
                 col = jnp.arange(capacity)[None, None, :]
@@ -536,8 +582,24 @@ class ParallelAttention(nn.Module):
                 ctx_t = jnp.einsum(
                     "tnc,scnd,ts->tnd",
                     probs,
-                    v_buf.astype(jnp.float32),
+                    vc_read.astype(jnp.float32),
                     onehot,
+                )
+            elif paged is not None:
+                # flash paged: the composed op runs the intra-chunk
+                # segments kernel + the page-table-gather prefix read
+                # (bounded by pages actually live) and merges by lse
+                from rocm_apex_tpu.ops.flash_attention_segments import (
+                    flash_attention_chunk_paged,
+                )
+
+                ctx_t = flash_attention_chunk_paged(
+                    qq.transpose(1, 0, 2),
+                    kq.transpose(1, 0, 2),
+                    vq.transpose(1, 0, 2),
+                    chunk_slots,
+                    k_buf, v_buf, table, lengths,
+                    scale, k_scale=k_sc, v_scale=v_sc,
                 )
             else:
                 # flash: two pieces merged by log-sum-exp weights.
@@ -599,59 +661,145 @@ class ParallelAttention(nn.Module):
                 1, budget, nh_local * hd
             )
         elif cache is not None:
-            k_buf, v_buf, lengths = cache
+            k_buf, v_buf, lengths = cache[:3]
+            paged = cache[3] if len(cache) > 3 else None
             q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, sq, nh, hd)
-            # write the new keys/values at each slot's current length
-            # (per-row dynamic_update_slice: in place under jit with
-            # donated cache buffers). lengths do NOT advance here —
-            # every layer writes at the same offsets; the transformer
-            # advances once per forward.
-            def _write(buf, new, start):
-                return jax.lax.dynamic_update_slice(
-                    buf, new.astype(buf.dtype), (start, 0, 0)
+            k_sc = v_sc = None
+            if paged is None:
+                # write the new keys/values at each slot's current
+                # length (per-row dynamic_update_slice: in place under
+                # jit with donated cache buffers). lengths do NOT
+                # advance here — every layer writes at the same
+                # offsets; the transformer advances once per forward.
+                def _write(buf, new, start):
+                    return jax.lax.dynamic_update_slice(
+                        buf, new.astype(buf.dtype), (start, 0, 0)
+                    )
+
+                k_buf = jax.vmap(_write)(k_buf, k, lengths)
+                v_buf = jax.vmap(_write)(v_buf, v, lengths)
+                new_kv = (k_buf, v_buf)
+            else:
+                if sq != 1:
+                    raise ValueError(
+                        "a paged cache serves single-token decode and "
+                        "chunked prefill; whole-prompt prefill needs "
+                        "the contiguous cache (or chunk=)"
+                    )
+                from rocm_apex_tpu.ops.paging import (
+                    paged_scatter,
+                    quantized_paged_scatter,
                 )
 
-            k_buf = jax.vmap(_write)(k_buf, k, lengths)
-            v_buf = jax.vmap(_write)(v_buf, v, lengths)
-            new_kv = (k_buf, v_buf)
+                table = paged["page_table"]
+                # one token per slot at its current length; positions
+                # at/past capacity DROP (never clamp into a live —
+                # possibly shared — page; the engine masks dead rows
+                # by sentineling their lengths to capacity)
+                w_slots = jnp.arange(b, dtype=jnp.int32)
+                flat_k = k.reshape(b, nh_local, hd)
+                flat_v = v.reshape(b, nh_local, hd)
+                if paged["k_scale"] is not None:
+                    k_buf, k_sc = quantized_paged_scatter(
+                        k_buf, paged["k_scale"], table,
+                        w_slots, lengths, flat_k,
+                    )
+                    v_buf, v_sc = quantized_paged_scatter(
+                        v_buf, paged["v_scale"], table,
+                        w_slots, lengths, flat_v,
+                    )
+                    new_kv = (k_buf, v_buf, k_sc, v_sc)
+                else:
+                    k_buf = paged_scatter(
+                        k_buf, table, w_slots, lengths, flat_k
+                    )
+                    v_buf = paged_scatter(
+                        v_buf, table, w_slots, lengths, flat_v
+                    )
+                    new_kv = (k_buf, v_buf)
             qf = q.transpose(0, 2, 1, 3).reshape(b * nh_local, sq, hd)
             if sq == 1:
                 # single-token decode against the cache: each slot
                 # attends its live prefix [0, lengths + 1) — junk
                 # beyond it (evicted predecessors, prefill padding) is
                 # masked by the per-row bound
-                capacity = k_buf.shape[1]
-                kf = (
-                    k_buf.transpose(0, 2, 1, 3)
-                    .reshape(b * nh_local, capacity, hd)
-                )
-                vf = (
-                    v_buf.transpose(0, 2, 1, 3)
-                    .reshape(b * nh_local, capacity, hd)
-                )
-                kv_len = jnp.repeat(
-                    jnp.minimum(lengths + 1, capacity), nh_local
-                )
-                if cfg.attention_impl == "jnp":
-                    scores = jnp.einsum(
-                        "bqd,bkd->bqk",
-                        qf.astype(jnp.float32),
-                        kf.astype(jnp.float32),
-                    ) * scale
-                    col = jnp.arange(capacity)[None, None, :]
-                    scores = jnp.where(
-                        col < kv_len[:, None, None], scores, -jnp.inf
-                    )
-                    probs = jax.nn.softmax(scores, axis=-1)
-                    ctxf = jnp.einsum(
-                        "bqk,bkd->bqd", probs, vf.astype(jnp.float32)
-                    ).astype(cfg.dtype)
-                else:
-                    from rocm_apex_tpu.ops.flash_attention import (
-                        flash_attention_decode,
-                    )
+                if paged is not None:
+                    capacity = table.shape[1] * paged["page_size"]
+                    kv_len_slot = jnp.minimum(lengths + 1, capacity)
+                    if cfg.attention_impl != "jnp":
+                        from rocm_apex_tpu.ops.flash_attention import (
+                            flash_attention_decode_paged,
+                        )
 
-                    ctxf = flash_attention_decode(qf, kf, vf, kv_len, scale)
+                        # the page-table-gather read: HBM traffic is
+                        # bounded by pages actually live, not the
+                        # fixed-capacity tail
+                        ctxf = flash_attention_decode_paged(
+                            qf, k_buf, v_buf, table, kv_len_slot,
+                            scale, k_scale=k_sc, v_scale=v_sc,
+                        )
+                    else:
+                        from rocm_apex_tpu.ops.paging import paged_view
+
+                        kf = (
+                            paged_view(k_buf, table, scale=k_sc)
+                            .transpose(0, 2, 1, 3)
+                            .reshape(b * nh_local, capacity, hd)
+                        )
+                        vf = (
+                            paged_view(v_buf, table, scale=v_sc)
+                            .transpose(0, 2, 1, 3)
+                            .reshape(b * nh_local, capacity, hd)
+                        )
+                        kv_len = jnp.repeat(kv_len_slot, nh_local)
+                        scores = jnp.einsum(
+                            "bqd,bkd->bqk",
+                            qf.astype(jnp.float32),
+                            kf.astype(jnp.float32),
+                        ) * scale
+                        col = jnp.arange(capacity)[None, None, :]
+                        scores = jnp.where(
+                            col < kv_len[:, None, None], scores,
+                            -jnp.inf,
+                        )
+                        probs = jax.nn.softmax(scores, axis=-1)
+                        ctxf = jnp.einsum(
+                            "bqk,bkd->bqd", probs,
+                            vf.astype(jnp.float32),
+                        ).astype(cfg.dtype)
+                else:
+                    capacity = k_buf.shape[1]
+                    kf = (
+                        k_buf.transpose(0, 2, 1, 3)
+                        .reshape(b * nh_local, capacity, hd)
+                    )
+                    vf = (
+                        v_buf.transpose(0, 2, 1, 3)
+                        .reshape(b * nh_local, capacity, hd)
+                    )
+                    kv_len = jnp.repeat(
+                        jnp.minimum(lengths + 1, capacity), nh_local
+                    )
+                    if cfg.attention_impl == "jnp":
+                        scores = jnp.einsum(
+                            "bqd,bkd->bqk",
+                            qf.astype(jnp.float32),
+                            kf.astype(jnp.float32),
+                        ) * scale
+                        col = jnp.arange(capacity)[None, None, :]
+                        scores = jnp.where(
+                            col < kv_len[:, None, None], scores, -jnp.inf
+                        )
+                        probs = jax.nn.softmax(scores, axis=-1)
+                        ctxf = jnp.einsum(
+                            "bqk,bkd->bqd", probs, vf.astype(jnp.float32)
+                        ).astype(cfg.dtype)
+                    else:
+                        from rocm_apex_tpu.ops.flash_attention import (
+                            flash_attention_decode,
+                        )
+
+                        ctxf = flash_attention_decode(qf, kf, vf, kv_len, scale)
             else:
                 # prefill: slots start empty (lengths == 0), so causal
                 # attention over the fresh window IS the full history —
@@ -988,16 +1136,41 @@ class ParallelTransformer(nn.Module):
         )
         delta = None
         new_k, new_v = [], []
+        new_ks, new_vs = [], []
+        # paged caches (inference/paging.py PagedKVCache — duck-typed:
+        # this module never imports it) route the per-layer view with a
+        # 4th element carrying the page table / page size / int8 scales
+        cache_paged = (
+            cache is not None
+            and getattr(cache, "page_table", None) is not None
+        )
         for i in range(n):
             if cache is not None:
-                x, (k_i, v_i) = layer_cls(
+                layer_cache = (cache.k[i], cache.v[i], cache.lengths)
+                if cache_paged:
+                    layer_cache = layer_cache + (dict(
+                        page_table=cache.page_table,
+                        page_size=cache.page_size,
+                        k_scale=(
+                            None if cache.k_scale is None
+                            else cache.k_scale[i]
+                        ),
+                        v_scale=(
+                            None if cache.v_scale is None
+                            else cache.v_scale[i]
+                        ),
+                    ),)
+                x, kv_i = layer_cls(
                     self.cfg, self.attn_mask_type, name=f"layer_{i}"
                 )(
                     x, attention_mask, deterministic, None, False,
-                    (cache.k[i], cache.v[i], cache.lengths), chunk,
+                    layer_cache, chunk,
                 )
-                new_k.append(k_i)
-                new_v.append(v_i)
+                new_k.append(kv_i[0])
+                new_v.append(kv_i[1])
+                if len(kv_i) > 2:  # quantized paged: updated scales
+                    new_ks.append(kv_i[2])
+                    new_vs.append(kv_i[3])
                 continue
             out = layer_cls(
                 self.cfg, self.attn_mask_type, name=f"layer_{i}"
@@ -1037,20 +1210,28 @@ class ParallelTransformer(nn.Module):
             x = x + delta.astype(x.dtype)
         x = x.astype(self.cfg.dtype)
         if cache is not None:
+            repl = dict(k=tuple(new_k), v=tuple(new_v))
+            if new_ks:
+                repl.update(
+                    k_scale=tuple(new_ks), v_scale=tuple(new_vs)
+                )
             if chunk is not None:
                 # chunked prefill: tokens landed at explicit per-slot
                 # offsets, a variable number per slot — the ENGINE
                 # commits the new cursors once per tick (lengths are
                 # untouched here)
-                return x, cache.replace(k=tuple(new_k), v=tuple(new_v))
+                return x, cache.replace(**repl)
             # every layer wrote at the same offsets; advance ONCE, for
-            # all slots (the engine masks inactive slots afterwards)
+            # all slots (the engine masks inactive slots afterwards).
+            # capacity via the cache protocol: a paged pool's k[0] is
+            # (num_pages, heads, page_size, hd), not per-slot rows
             return x, cache.replace(
-                k=tuple(new_k),
-                v=tuple(new_v),
                 lengths=jnp.minimum(
-                    cache.lengths + x.shape[1], cache.k[0].shape[1]
+                    cache.lengths + x.shape[1],
+                    getattr(cache, "capacity", None)
+                    or cache.k[0].shape[1],
                 ),
+                **repl,
             )
         return x
 

@@ -160,6 +160,10 @@ class MetricsLogger:
             "overflows",
             "admitted", "evicted", "prompt_tokens",
             "generated_tokens", "decode_steps", "mixed_steps",
+            # the paged cache's monotonic counters (CoW forks, prefix
+            # admissions/tokens, pool-backpressure stalls)
+            "cow_forks", "prefix_hits", "prefix_hit_tokens",
+            "page_stalls",
         ),
         timers: Optional[Timers] = None,
         memory_stats: bool = True,

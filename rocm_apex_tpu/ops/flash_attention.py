@@ -37,6 +37,7 @@ __all__ = [
     "flash_attention",
     "flash_attention_varlen",
     "flash_attention_decode",
+    "flash_attention_decode_paged",
     "flash_attention_with_lse",
     "flash_attention_dropout",
     "flash_attention_qkv",
@@ -936,6 +937,214 @@ def flash_attention_decode(
             pltpu.VMEM((block_t, d), jnp.float32),
         ],
     )(qp, kp, vp, jnp.asarray(kv_lengths, jnp.int32))
+    if return_lse:
+        return o[:, :t, :d0], lse[:, :t, 0]
+    return o[:, :t, :d0]
+
+
+# ---------------------------------------------------------------------------
+# paged KV-cache decode: page-table-gather read path
+# ---------------------------------------------------------------------------
+
+
+def _decode_paged_kernel(
+    scale, nh, ps, num_pages, block_t, quantized,
+    tab_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+):
+    """Online-softmax decode against a PAGED cache for grid point
+    (b, j): batch row b = slot·nh + head, j walks the slot's page
+    list. The kv tile for (b, j) was fetched by the scalar-prefetch
+    index maps through the page table, so the kernel sees exactly the
+    pages the slot owns — the fixed-capacity dead tail the contiguous
+    `_decode_kernel` still DMAs (its skip is compute-only) never
+    leaves HBM here: past-the-prefix grid steps re-point their fetch
+    at the last live page, and Pallas elides the DMA for a repeated
+    block index. Same accumulation as `_decode_kernel` (base-2 online
+    softmax, natural-log lse at the boundary).
+
+    ``quantized`` adds per-(page, head) fp32 dequantization: int8
+    tiles are scaled into the score/value dots from SMEM-resident
+    scale tables (one scalar read per tile)."""
+    if quantized:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    slot = b // nh
+    head = b % nh
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        ln = len_ref[slot]
+        q = q_ref[0]
+        k = k_ref[0, 0]  # (ps, d)
+        v = v_ref[0, 0]
+        if quantized:
+            # the page this grid step actually fetched (the index-map
+            # clamp replayed in-body so tile and scale can't disagree)
+            live = jnp.maximum((ln + ps - 1) // ps, 1)
+            jeff = jnp.minimum(j, live - 1)
+            page = jnp.minimum(tab_ref[slot, jeff], num_pages - 1)
+            k = (k.astype(jnp.float32) * ks_ref[page, head]).astype(
+                q.dtype
+            )
+            v = (v.astype(jnp.float32) * vs_ref[page, head]).astype(
+                q.dtype
+            )
+        s = jax.lax.dot_general(
+            (q * jnp.asarray(scale * LOG2E, q.dtype)), k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )
+        col = j * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (block_t, ps), 1
+        )
+        s = jnp.where(col < ln, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot(
+            p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32, precision=_PREC,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # pages wholly past the live prefix: no compute AND no fetch (the
+    # index map re-pointed their DMA at an already-resident page)
+    pl.when(j * ps < len_ref[slot])(_body)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(
+            l > 0.0,
+            (m_scr[:, :1] + jnp.log2(safe_l)) * LN2,
+            NEG_INF,
+        )
+
+
+def flash_attention_decode_paged(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    return_lse: bool = False,
+):
+    """`flash_attention_decode` reading through a block table.
+
+    ``q`` is (num_slots·heads, t, head_dim), slot-major (row
+    ``s·heads + n`` holds slot s, head n — the layout the model's
+    head-flatten produces). ``k_pool``/``v_pool`` are the shared page
+    pools, (num_pages, heads, page_size, head_dim); ``page_table`` is
+    (num_slots, pages_per_slot) int32 mapping each slot's page list
+    into the pool (unmapped entries carry the ``num_pages`` sentinel
+    and are never fetched within a live prefix); ``kv_lengths`` is
+    (num_slots,) int32 — slot s attends cache positions
+    ``[0, kv_lengths[s])``. The grid walks (slot·head, page): each kv
+    tile is ONE page, fetched via a scalar-prefetch index map that
+    resolves the table on the fly, so HBM reads are bounded by pages
+    actually live — the paged answer to the contiguous kernel's
+    fixed-capacity tail DMA.
+
+    ``k_scale``/``v_scale`` ((num_pages, heads) fp32) switch the pools
+    to int8 with per-(page, head) dequantization inside the kernel's
+    inner loop (the cache-bytes half of the EQuARX trade). Forward
+    only, like every decode read. ``return_lse`` as in
+    `flash_attention_decode` (rows with an empty prefix carry
+    -inf-tier lse so a log-sum-exp merge drops them).
+    """
+    bh, t, d0 = q.shape
+    num_pages, nh, ps, dp = k_pool.shape
+    num_slots, pages_per_slot = page_table.shape
+    if dp != d0:
+        raise ValueError(
+            f"pool head_dim {dp} != query head_dim {d0}"
+        )
+    if bh != num_slots * nh:
+        raise ValueError(
+            f"q rows {bh} must equal num_slots {num_slots} * pool "
+            f"heads {nh} (slot-major)"
+        )
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    s = scale if scale is not None else 1.0 / np.sqrt(d0)
+    d = _round_up(d0, 128)
+    block_t = _round_up(t, DECODE_BLOCK_T)
+    qp = jnp.pad(q, ((0, 0), (0, block_t - t), (0, d - d0)))
+    kp = jnp.pad(k_pool, ((0, 0), (0, 0), (0, 0), (0, d - d0)))
+    vp = jnp.pad(v_pool, ((0, 0), (0, 0), (0, 0), (0, d - d0)))
+
+    def _page_map(b, j, tab, lens):
+        # clamp dead/unmapped steps onto the last LIVE page: a repeated
+        # block index is not refetched, so the dead tail costs no DMA
+        slot = b // nh
+        live = jnp.maximum((lens[slot] + ps - 1) // ps, 1)
+        jeff = jnp.minimum(j, live - 1)
+        return (jnp.minimum(tab[slot, jeff], num_pages - 1), b % nh, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block_t, d), lambda b, j, tab, lens: (b, 0, 0)),
+        pl.BlockSpec((1, 1, ps, d), _page_map),
+        pl.BlockSpec((1, 1, ps, d), _page_map),
+    ]
+    ins = [qp, kp, vp]
+    if quantized:
+        smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+        in_specs += [smem, smem]
+        ins += [
+            jnp.asarray(k_scale, jnp.float32),
+            jnp.asarray(v_scale, jnp.float32),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, pages_per_slot),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_t, d), lambda b, j, tab, lens: (b, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_t, 1), lambda b, j, tab, lens: (b, 0, 0)
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 128), jnp.float32),
+            pltpu.VMEM((block_t, 128), jnp.float32),
+            pltpu.VMEM((block_t, d), jnp.float32),
+        ],
+    )
+    o, lse = pallas_call(
+        functools.partial(
+            _decode_paged_kernel, s, nh, ps, num_pages, block_t,
+            quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, block_t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, block_t, 1), jnp.float32),
+        ],
+    )(
+        jnp.asarray(page_table, jnp.int32),
+        jnp.asarray(kv_lengths, jnp.int32),
+        *ins,
+    )
     if return_lse:
         return o[:, :t, :d0], lse[:, :t, 0]
     return o[:, :t, :d0]

@@ -52,6 +52,7 @@ from rocm_apex_tpu.ops.flash_attention import (
 __all__ = [
     "flash_attention_segments",
     "flash_attention_segments_with_lse",
+    "flash_attention_chunk_paged",
 ]
 
 DEFAULT_BLOCK = 512
@@ -393,6 +394,77 @@ def flash_attention_segments_with_lse(
         scale if scale is not None else 1.0 / np.sqrt(q.shape[-1]),
         block_q, block_k,
     )
+
+
+def flash_attention_chunk_paged(
+    q: jnp.ndarray,
+    k_chunk: jnp.ndarray,
+    v_chunk: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Chunked-prefill attention against a PAGED cache prefix.
+
+    The mixed-step read in one op: a packed chunk of prompt pieces
+    attends (A) its own stream under segment-causal masking (this
+    module's kernel — tokens of different slots never talk) and (B)
+    each token's slot's PRE-CHUNK cache prefix, read THROUGH the page
+    table (`flash_attention_decode_paged` — pages actually live bound
+    the DMA, int8 pools dequantize in-kernel via the per-(page, head)
+    scales); the two pieces merge by log-sum-exp weights, exactly the
+    contiguous chunk path's merge in models/gpt.py.
+
+    ``q``/``k_chunk``/``v_chunk``: (heads, budget, head_dim) — the
+    chunk's FRESH projections (piece A reads them at full precision;
+    quantization only ever touches prefix reads). ``segment_ids``:
+    (budget,) per-token slot ids, ``num_slots`` marking padding.
+    ``k_pool``/``v_pool``/``page_table``/``kv_lengths``/scales as in
+    `flash_attention_decode_paged` (lengths are each slot's
+    pre-chunk materialized length). Returns fp32
+    (budget, heads, head_dim) — token-major, output-projection-ready.
+    Forward only (serving never differentiates).
+    """
+    from rocm_apex_tpu.ops.flash_attention import (
+        flash_attention_decode_paged,
+    )
+
+    nh, budget, d0 = q.shape
+    num_slots = page_table.shape[0]
+    s = scale if scale is not None else 1.0 / np.sqrt(d0)
+    o_a, lse_a = flash_attention_segments_with_lse(
+        q, k_chunk, v_chunk, segment_ids, causal=True, scale=s
+    )
+    # every slot scores the WHOLE chunk against its prefix (chunk-width
+    # cache read, not per-token width); each token keeps its own slot's
+    # row below
+    qB = jnp.broadcast_to(
+        q[None], (num_slots, nh, budget, d0)
+    ).reshape(num_slots * nh, budget, d0)
+    o_b, lse_b = flash_attention_decode_paged(
+        qB, k_pool, v_pool, page_table, kv_lengths, s,
+        k_scale=k_scale, v_scale=v_scale, return_lse=True,
+    )
+    o_b = o_b.reshape(num_slots, nh, budget, d0)
+    lse_b = lse_b.reshape(num_slots, nh, budget)
+    slot_c = jnp.clip(segment_ids, 0, num_slots - 1)
+    tok = jnp.arange(budget)
+    o_b = o_b[slot_c, :, tok]  # (budget, nh, hd)
+    lse_b = lse_b[slot_c, :, tok]  # (budget, nh)
+    o_a = o_a.transpose(1, 0, 2)  # (budget, nh, hd)
+    lse_a = lse_a.transpose(1, 0)
+    m = jnp.maximum(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - m)
+    w_b = jnp.exp(lse_b - m)
+    return (
+        w_a[..., None] * o_a.astype(jnp.float32)
+        + w_b[..., None] * o_b.astype(jnp.float32)
+    ) / (w_a + w_b)[..., None]
 
 
 def _fas_fwd(q, k, v, segment_ids, causal, scale, block_q, block_k):

@@ -395,6 +395,31 @@ class TestEngine:
         assert r.finish_reason == "capacity"
         assert len(r.prompt) + len(r.tokens) - 1 <= 8
 
+    def test_capacity_guard_raises_host_side_not_clamps(self):
+        """The ISSUE-7 clamp fix. (a) The legitimate edge — a prompt
+        that exactly fills capacity — completes with ONE token and
+        finish_reason='capacity': its fused first-token decode is
+        SUPPRESSED (completion_idx=-1), where the old path issued a
+        device write at `capacity` that dynamic_update_slice silently
+        clamped onto the last live row. (b) A live slot positioned at
+        capacity entering decode (an invariant violation) raises a
+        host-side error naming the slot, instead of wedging the
+        length at the clamp forever."""
+        cfg = fp32_cfg()
+        model, params = make_model(cfg)
+        eng = greedy_engine(model, params)
+        r = eng.generate(
+            [list(range(eng.capacity))], max_new_tokens=5
+        )[0]
+        assert r.finish_reason == "capacity"
+        assert len(r.tokens) == 1
+        eng2 = greedy_engine(model, params)
+        eng2.add_request([1, 2, 3], max_new_tokens=20)
+        eng2.step()
+        eng2._slots[0].pos = eng2.capacity  # white-box corruption
+        with pytest.raises(RuntimeError, match="slot 0"):
+            eng2.step()
+
     def test_request_validation(self):
         cfg = fp32_cfg()
         model, params = make_model(cfg)
