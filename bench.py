@@ -334,7 +334,7 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
 def bench_serve(budget: int = 0, whole_prompt: bool = False,
                 trace: str = "", paged: bool = False,
                 page_size: int = 0, kv_dtype: str = "",
-                shared_prefix: bool = False):
+                shared_prefix: bool = False, spec_k: int = -1):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -374,10 +374,24 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     ``--shared-prefix`` switches to the shared-system-prompt workload
     and A/Bs paged+prefix-sharing against plain paged: same tokens,
     ``prefix_hits``/``shared_page_ratio`` > 0, and the TTFT p95 win
-    reports under ``gpt_serve_ttft_ms_shared_prefix``."""
+    reports under ``gpt_serve_ttft_ms_shared_prefix``.
+
+    ``--spec-k=K`` A/Bs speculative decoding (n-gram self-drafting
+    through the mixed step, `inference/drafting.py`) against the
+    non-speculative chunked engine on a HIGH-ACCEPTANCE workload:
+    periodic prompts whose greedy continuations repeat, the regime the
+    suffix-matching drafter locks onto. Greedy tokens are asserted
+    IDENTICAL (and again on quick paged-bf16 and paged-int8 passes —
+    the rollback path must be invisible in tokens on every cache
+    layout), throughput reports under
+    ``gpt_serve_tokens_per_sec_per_chip_spec{K}`` with vs_baseline =
+    spec/non-spec, and the stderr line carries acceptance rate,
+    drafted/accepted totals, and TTFT/TPOT p95. ``--spec-k=0`` runs
+    only the baseline series."""
     from rocm_apex_tpu.inference import InferenceEngine, SamplingParams
 
     on_tpu = jax.default_backend() == "tpu"
+    req_budget = budget  # pre-default: the spec branch sizes its own
     import numpy as np
 
     if on_tpu:
@@ -415,6 +429,126 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )
     rng = np.random.RandomState(0)
+
+    if spec_k >= 0:
+        # ---- speculative-decoding A/B. The workload is periodic on
+        # purpose: a tiny greedy model continues a repeating prompt
+        # with the same period, so the n-gram drafter's proposals are
+        # mostly right and the measured win is the DESIGN's ceiling
+        # regime (k accepted tokens per cache sweep). Random-prompt
+        # traffic exercises the rollback path instead — covered by the
+        # paged parity passes below and the L0 suite.
+        # decode-heavy on purpose: speculative decoding amortizes the
+        # DECODE tick, so short periodic prompts + a long generation
+        # phase isolate the per-token win from prefill fixed costs
+        n_req = 16 if on_tpu else 8
+        spec_new = 128 if on_tpu else 96
+        reps = 8 if on_tpu else 5
+        prompts = []
+        for i in range(n_req):
+            p = 3 + i % 4  # periods 3..6: all hit the 3/2-gram cascade
+            cyc = rng.randint(1, cfg.vocab_size, size=p).tolist()
+            prompts.append((cyc * (reps + 1))[: p * reps + i % 3])
+        # every decoding slot needs k+1 chunk rows per tick for its
+        # span (last token + k drafts) — and no more: each extra
+        # budget row is dead weight in every spec tick's fused chunk
+        sbudget = req_budget or (num_slots * (max(spec_k, 2) + 1))
+
+        def run_spec(k, paged_kv=None, use_paged=False, reqs=None,
+                     new_toks=None):
+            eng = InferenceEngine(
+                model, params, num_slots=num_slots, capacity=capacity,
+                sampling=SamplingParams(temperature=0.0), seed=0,
+                prefill_token_budget=sbudget, spec_k=k,
+                paged=use_paged,
+                page_size=(page_size or (64 if on_tpu else 16))
+                if use_paged else 16,
+                kv_dtype=paged_kv,
+            )
+            work = reqs if reqs is not None else prompts
+            # warmup long enough that accepted spans COMMIT (a span
+            # that finishes its request skips the commit program —
+            # 3-token warmups would leave that compile in the timed
+            # window)
+            eng.generate(work[:num_slots], max_new_tokens=10)
+            eng.reset_stats()
+            t0 = time.perf_counter()
+            results = eng.generate(
+                work, max_new_tokens=new_toks or spec_new
+            )
+            dt = time.perf_counter() - t0
+            gen = sum(len(r.tokens) for r in results)
+            return eng, [r.tokens for r in results], gen / dt, dt
+
+        eng_b, toks_b, rate_b, dt_b = run_spec(0)
+        s_b = eng_b.stats()
+        tpot_b = [c["tpot_ms"] for c in eng_b.completions]
+        print(
+            f"serve[spec0]: {rate_b:.1f} gen tok/s over {dt_b:.2f}s "
+            f"(budget={sbudget}) ttft p95={s_b['ttft_ms_p95']:.0f}ms "
+            f"tpot p95={np.percentile(tpot_b, 95):.1f}ms",
+            file=sys.stderr,
+        )
+        if spec_k == 0:
+            _report("gpt_serve_tokens_per_sec_per_chip_spec0", rate_b,
+                    "tokens/s", 1.0, "")
+            return
+        eng_s, toks_s, rate_s, dt_s = run_spec(spec_k)
+        # a throughput win that changes tokens is not a win: greedy
+        # speculative output must be TOKEN-IDENTICAL to the baseline
+        for i, (tb, ts) in enumerate(zip(toks_b, toks_s)):
+            assert tb == ts, f"spec-k={spec_k} changed tokens (req {i})"
+        s_s = eng_s.stats()
+        tpot_s = [c["tpot_ms"] for c in eng_s.completions]
+        assert eng_s.mixed_trace_count == 1, (
+            f"spec mixed step traced {eng_s.mixed_trace_count}x"
+        )
+        # quick parity passes on the paged layouts (reduced workload):
+        # the accept/rollback walk must be invisible in tokens whether
+        # rejected rows would have landed in bf16 or int8 pages
+        sub = prompts[: num_slots + 2]
+        for kvd in (None, jnp.int8):
+            _, pb, _, _ = run_spec(0, paged_kv=kvd, use_paged=True,
+                                   reqs=sub, new_toks=12)
+            _, ps_, _, _ = run_spec(spec_k, paged_kv=kvd,
+                                    use_paged=True, reqs=sub,
+                                    new_toks=12)
+            name = "int8" if kvd is not None else "bf16"
+            assert pb == ps_, (
+                f"spec-k={spec_k} changed tokens on the paged {name} "
+                f"cache"
+            )
+        acc = s_s["acceptance_rate"]
+        print(
+            f"serve[spec{spec_k}]: {rate_s:.1f} gen tok/s over "
+            f"{dt_s:.2f}s vs baseline {rate_b:.1f} "
+            f"({rate_s / rate_b:.2f}x); acceptance={acc:.2f} "
+            f"({s_s['tokens_accepted']:.0f}/"
+            f"{s_s['tokens_drafted']:.0f} drafted, "
+            f"{s_s['rollbacks']:.0f} rollbacks) "
+            f"ttft p95={s_s['ttft_ms_p95']:.0f}ms "
+            f"tpot p95={np.percentile(tpot_s, 95):.1f}ms; tokens "
+            f"identical (contiguous + paged bf16/int8)",
+            file=sys.stderr,
+        )
+        _report(
+            f"gpt_serve_tokens_per_sec_per_chip_spec{spec_k}", rate_s,
+            "tokens/s", rate_s / rate_b,
+            f"spec-k={spec_k} {rate_s:.1f} vs non-spec {rate_b:.1f} "
+            f"tok/s (speedup = vs_baseline); acceptance {acc:.2f}; "
+            f"tokens identical on contiguous/paged/int8",
+        )
+        _report(
+            f"gpt_serve_tpot_ms_spec{spec_k}",
+            float(np.percentile(tpot_s, 95)), "ms",
+            float(np.percentile(tpot_b, 95))
+            / max(float(np.percentile(tpot_s, 95)), 1e-9),
+            f"tpot p95: spec {np.percentile(tpot_s, 95):.1f} ms vs "
+            f"baseline {np.percentile(tpot_b, 95):.1f} ms "
+            f"(ratio = vs_baseline); ttft p95 "
+            f"{s_s['ttft_ms_p95']:.0f} vs {s_b['ttft_ms_p95']:.0f} ms",
+        )
+        return
     if shared_prefix:
         # shared-system-prompt traffic (the millions-of-users regime:
         # most tokens of most requests are the same tokens): one fixed
@@ -945,11 +1079,17 @@ def bench_ln():
 def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
          remat: bool = False, loss: str = "fused",
          seq_parallel: bool = False, collective_matmul: bool = False,
-         audit: bool = False):
+         audit: bool = False, dist_opt: bool = False):
     if loss not in ("fused", "naive"):
         raise SystemExit(f"--loss must be 'fused' or 'naive', got {loss!r}")
     if collective_matmul and not seq_parallel:
         raise SystemExit("--collective-matmul requires --seq-parallel")
+    if dist_opt and seq_parallel:
+        raise SystemExit(
+            "--dist-opt does not compose with --seq-parallel"
+        )
+    if dist_opt and loss != "fused":
+        raise SystemExit("--dist-opt measures the fused-loss path")
     on_tpu = jax.default_backend() == "tpu"
     # tp-axis A/B: shard the model over ALL visible chips on the
     # tensor axis with sequence-parallel activations between the TP
@@ -1017,6 +1157,127 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         )(tokens[:1])
     else:
         params32 = model.init(jax.random.PRNGKey(1), tokens[:1])
+
+    if dist_opt:
+        # ---- ZeRO-sharded data-parallel training (--dist-opt): the
+        # contrib DistributedFusedAdam replaces the replicated
+        # MixedPrecisionAdam — each rank feeds its UNREDUCED local
+        # grads straight into the transform (no pre-pmean: the
+        # reduce-scatter IS the gradient averaging), updates only its
+        # 1/dp master/moment shards, and all-gathers fresh params.
+        # Optimizer state per chip shrinks by dp; the metric line
+        # reports the measured bytes next to step time.
+        import numpy as np
+        import optax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from rocm_apex_tpu.contrib.optimizers import (
+            distributed_fused_adam,
+        )
+
+        dp = len(jax.devices())
+        batch = max(dp, (batch // dp) * dp)
+        tokens = tokens[:batch]
+        labels = labels[:batch]
+        dmesh = Mesh(np.array(jax.devices()), ("data",))
+        dist = distributed_fused_adam(
+            1e-4, weight_decay=0.01, allgather_dtype="fp32",
+            axis_name="data",
+        )
+        ostate = jax.jit(
+            shard_map(
+                dist.init, mesh=dmesh, in_specs=(P(),),
+                out_specs=P(), check_rep=False,
+            )
+        )(params32)
+
+        def local_runN_zero(params, ostate, rng, tok_l, lab_l):
+            def one(carry, _):
+                params, ostate, rng = carry
+                rng, step_rng = jax.random.split(rng)
+
+                def loss_fn(p):
+                    rngs = (
+                        {"dropout": step_rng} if dropout > 0.0 else None
+                    )
+                    return model.apply(
+                        p, tok_l, labels=lab_l, loss_reduction="mean",
+                        deterministic=dropout == 0.0, rngs=rngs,
+                    )
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, ostate2 = dist.update(grads, ostate, params)
+                return (
+                    optax.apply_updates(params, updates), ostate2, rng
+                ), loss
+
+            (params, ostate, rng), losses = jax.lax.scan(
+                one, (params, ostate, rng), None, length=iters,
+                unroll=2,
+            )
+            return params, ostate, rng, losses
+
+        runN_z = jax.jit(
+            shard_map(
+                local_runN_zero, mesh=dmesh,
+                in_specs=(P(), P(), P(), P("data"), P("data")),
+                out_specs=(P(), P(), P(), P()),
+                check_rep=False,
+            )
+        )
+        rng0 = _dropout_rng0(dropout, on_tpu)
+        params_z, ostate, rng0, losses = runN_z(
+            params32, ostate, rng0, tokens, labels
+        )
+        float(losses[-1])  # warmup + sync
+        t0 = time.perf_counter()
+        params_z, ostate, rng0, losses = runN_z(
+            params_z, ostate, rng0, tokens, labels
+        )
+        loss_val = float(losses[-1])
+        dt = (time.perf_counter() - t0) / iters
+
+        n_params = sum(
+            int(x.size) for x in jax.tree_util.tree_leaves(params32)
+        ) - cfg.vocab_size * cfg.hidden_size
+        raw_params = sum(
+            int(x.size) for x in jax.tree_util.tree_leaves(params32)
+        )
+        # sharded leaves leave the shard_map with local (1/dp) shapes:
+        # summing them IS the per-chip optimizer footprint. The
+        # replicated MixedPrecisionAdam reference holds fp32 master +
+        # m + v on every chip (12 bytes/param).
+        opt_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(ostate)
+        )
+        repl_bytes = 12 * raw_params
+        mb = 1.0 / (1024 * 1024)
+        step_flops = monitor.model_flops(
+            cfg, batch, seq, n_params=n_params
+        )
+        mfu = monitor.mfu(step_flops, dt, n_chips=dp)
+        suffix = "_dropout" if dropout > 0.0 else ""
+        if seq != default_seq:
+            suffix += f"_s{seq}"
+        if batch != default_batch:
+            suffix += f"_b{batch}"
+        if remat:
+            suffix += "_remat"
+        suffix += f"_zero_dp{dp}"
+        _report(
+            f"gpt_train_tokens_per_sec_per_chip{suffix}",
+            batch * seq / dt / dp, "tokens/s", mfu / 0.70,
+            f"step={dt*1000:.1f}ms loss={loss_val:.4f} mfu={mfu:.3f} "
+            f"optimizer state {opt_bytes*mb:.2f} MiB/chip (ZeRO "
+            f"dp={dp}; replicated fp32 master+m+v would be "
+            f"{repl_bytes*mb:.2f} MiB/chip) dropout={dropout} "
+            f"b={batch} s={seq} remat={remat} "
+            f"backend={jax.default_backend()}",
+        )
+        return
+
     state = opt.init(params32)
     sstate = scaler.init()
     rng0 = _dropout_rng0(dropout, on_tpu)
@@ -1282,6 +1543,10 @@ if __name__ == "__main__":
             kwargs["kv_dtype"] = a.split("=", 1)[1]
         elif a == "--shared-prefix":
             kwargs["shared_prefix"] = True
+        elif a.startswith("--spec-k="):
+            kwargs["spec_k"] = int(a.split("=", 1)[1])
+        elif a == "--dist-opt":
+            kwargs["dist_opt"] = True
         elif a.startswith("--fused="):
             kwargs["fused"] = bool(int(a.split("=", 1)[1]))
         elif a.startswith("--"):
@@ -1314,11 +1579,21 @@ if __name__ == "__main__":
         "budget" in kwargs or "whole_prompt" in kwargs
         or "trace" in kwargs or "paged" in kwargs
         or "page_size" in kwargs or "kv_dtype" in kwargs
-        or "shared_prefix" in kwargs
+        or "shared_prefix" in kwargs or "spec_k" in kwargs
     ) and which != "serve":
         raise SystemExit(
             "--budget/--whole-prompt/--trace/--paged/--page-size/"
-            "--kv-dtype/--shared-prefix apply to the serve bench"
+            "--kv-dtype/--shared-prefix/--spec-k apply to the serve "
+            "bench"
+        )
+    if kwargs.get("spec_k", 0) < 0:
+        raise SystemExit("--spec-k must be >= 0")
+    if "dist_opt" in kwargs and which != "gpt":
+        raise SystemExit("--dist-opt applies to the gpt bench")
+    if kwargs.get("dist_opt") and kwargs.get("seq_parallel"):
+        raise SystemExit(
+            "--dist-opt shards the optimizer over the data axis; it "
+            "does not compose with --seq-parallel (tensor axis)"
         )
     if kwargs.get("kv_dtype") not in (None, "int8"):
         raise SystemExit(

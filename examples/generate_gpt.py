@@ -56,6 +56,12 @@ def main():
     p.add_argument("--num-slots", type=int, default=2)
     p.add_argument("--num-requests", type=int, default=6)
     p.add_argument("--max-new-tokens", type=int, default=8)
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: up to K tokens per slot "
+                        "drafted by the n-gram self-drafter and "
+                        "verified in the same mixed step (0 = off; "
+                        "requires a token budget >= num_slots*(K+1) "
+                        "for full-rate drafting)")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
     p.add_argument("--top-k", type=int, default=None)
@@ -106,6 +112,7 @@ def main():
         prefill_token_budget=args.token_budget if chunked else None,
         prefill_chunk=args.prefill_chunk,
         tracer=tracer,
+        spec_k=args.spec_k,
     )
 
     rng = np.random.RandomState(args.seed)
@@ -130,6 +137,12 @@ def main():
           f"traces: mixed={eng.mixed_trace_count} "
           f"decode={eng.decode_trace_count} "
           f"prefill={eng.prefill_trace_count}")
+    if args.spec_k > 0:
+        print(f"speculative: k={args.spec_k} "
+              f"drafted={s['tokens_drafted']:.0f} "
+              f"accepted={s['tokens_accepted']:.0f} "
+              f"(acceptance={s['acceptance_rate']:.2f}) "
+              f"rollbacks={s['rollbacks']:.0f}")
     if args.trace is not None:
         n = tracer.export_chrome_trace(args.trace)
         req_path = args.trace + ".requests.jsonl"

@@ -26,7 +26,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+import optax
+
 from rocm_apex_tpu.amp import all_finite
+from rocm_apex_tpu.contrib.optimizers import distributed_fused_adam
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
 from rocm_apex_tpu.monitor import (
     FlightRecorder,
@@ -58,6 +61,16 @@ def _observability_args(parser):
              "nonfinite probes ride the step metrics and a NaN/Inf "
              "anomaly dumps a jsonl bundle to PATH "
              "(monitor.FlightRecorder)",
+    )
+    g2 = parser.add_argument_group(title="distributed optimizer")
+    g2.add_argument(
+        "--dist-opt", action="store_true",
+        help="shard the Adam state over the data-parallel axis "
+             "(contrib.optimizers.distributed_fused_adam: "
+             "reduce-scatter grads -> 1/dp-sharded update -> "
+             "allgather params, the reference DistributedFusedAdam "
+             "semantics); replaces the mixed-precision scaler path "
+             "with plain fp32, so loss_scale reads 1 in the metrics",
     )
     return parser
 
@@ -104,13 +117,62 @@ def main():
     model = GPTModel(cfg)
     opt = MixedPrecisionAdam(args.lr, weight_decay=args.weight_decay)
     scaler = GradScaler(axis_names=(parallel_state.TENSOR_AXIS,))
+    dist = (
+        distributed_fused_adam(
+            args.lr, weight_decay=args.weight_decay,
+            axis_name=parallel_state.DATA_AXIS,
+        )
+        if args.dist_opt else None
+    )
 
     b_local = args.micro_batch_size
     seq = args.seq_length
 
     def local_init(tokens):
         params32 = model.init(jax.random.PRNGKey(args.seed), tokens)
+        if dist is not None:
+            # ZeRO path: fp32 params beside 1/dp Adam shards; the
+            # scaler state stays in the carry only so both paths share
+            # one step/init signature
+            return (params32, dist.init(params32)), scaler.init()
         return opt.init(params32), scaler.init()
+
+    def local_step_dist(state, sstate, tokens, labels):
+        params, ostate = state
+
+        def loss_fn(p):
+            losses = model.apply(p, tokens, labels=labels)
+            return gpt_loss_fn(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # NO grad pmean here: the optimizer's reduce-scatter over the
+        # data axis IS the gradient averaging — that is the ZeRO
+        # bargain (all-reduce bytes, but the Adam state the result
+        # feeds lives 1/dp-sharded)
+        updates, ostate2 = dist.update(grads, ostate, params)
+        params2 = optax.apply_updates(params, updates)
+        metrics = (
+            Metrics.empty()
+            .record("loss", loss)
+            .record_norm("grad_norm", grads)
+            .record_ratio_norms(grads, params, prefix="grad_ratio")
+            # schema parity with the scaler path: fp32 grads don't
+            # overflow, so scale pins at 1 and overflows at 0
+            .record("loss_scale", jnp.float32(1.0))
+            .record("overflows", jnp.float32(0.0))
+        )
+        if args.flight_recorder is not None:
+            metrics = metrics.merge(Metrics(group_nonfinite(
+                grads, axis_name=parallel_state.TENSOR_AXIS
+            )))
+        # pre-reduce-scatter grads differ across dp ranks, so every
+        # scalar above is rank-local — mean them so the P() out_spec
+        # (check_rep=False) carries honest replicated values
+        metrics = jax.tree_util.tree_map(
+            lambda x: jax.lax.pmean(x, parallel_state.DATA_AXIS),
+            metrics,
+        )
+        return (params2, ostate2), sstate, metrics
 
     def local_step(state, sstate, tokens, labels):
         def loss_fn(p):
@@ -162,7 +224,8 @@ def main():
     )
     step_f = jax.jit(
         shard_map(
-            local_step, mesh=mesh,
+            local_step_dist if dist is not None else local_step,
+            mesh=mesh,
             in_specs=(P(), P(), data_spec, data_spec),
             out_specs=(P(), P(), P()),
             check_rep=False,
@@ -172,6 +235,18 @@ def main():
     rng = jax.random.PRNGKey(args.seed + 1)
     tokens0 = jnp.ones((b_local * dp, seq), jnp.int32)
     state, sstate = init_f(tokens0)
+    if dist is not None:
+        # sharded leaves exit shard_map at their LOCAL (1/dp) shapes
+        # under the P() out_spec, so summing bytes here reads the
+        # per-chip optimizer footprint directly
+        opt_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(state[1])
+        )
+        print(
+            f"ZeRO optimizer state: {opt_bytes / 2**20:.2f} MiB/chip "
+            f"(dp={dp})"
+        )
 
     # host-side pipeline (monitor.MetricsLogger): jsonl metric lines on
     # stdout every log_interval steps — window means of the in-graph

@@ -13,6 +13,10 @@ nothing is forked:
                optional int8 pools with per-(page, head) scales
     sampling   greedy / temperature / top-k / top-p, jit-able and
                seed-deterministic
+    drafting   n-gram self-drafter for speculative decoding: proposes
+               up to k continuation tokens per slot by suffix-matching
+               the slot's own history (no draft model); pluggable hook
+               protocol for learned drafters
     engine     continuous-batching serving loop: fixed slot grid,
                request queue, per-step admit/evict, and the chunked-
                prefill token-budget scheduler — ONE compiled mixed
@@ -25,6 +29,7 @@ The model side lives in `models/gpt.py` (``cache=`` on `GPTModel`) and
 the cache layout and the serving loop. See docs/inference.md.
 """
 
+from rocm_apex_tpu.inference.drafting import NGramDrafter  # noqa: F401
 from rocm_apex_tpu.inference.engine import (  # noqa: F401
     GenerationResult,
     InferenceEngine,
@@ -50,6 +55,7 @@ __all__ = [
     "PageAllocator",
     "PrefixStore",
     "InferenceEngine",
+    "NGramDrafter",
     "Request",
     "GenerationResult",
     "SamplingParams",

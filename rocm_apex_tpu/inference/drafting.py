@@ -1,0 +1,144 @@
+"""N-gram self-drafting for speculative decoding.
+
+Decode is memory-bound: every emitted token pays one full pass over the
+slot's K/V prefix (the PR-5 measurement — per-token cache access
+dominates the mixed tick). Speculative decoding (arXiv 2211.17192)
+amortizes that read: propose k cheap draft tokens, score all of them in
+ONE forward pass, keep the longest prefix the model agrees with. The
+verification kernel already exists here — the chunked mixed step scores
+an arbitrary multi-token span against a slot's cache prefix and samples
+every packed position — so the only missing piece is a proposer.
+
+`NGramDrafter` is the zero-cost proposer: instead of a separate draft
+model it suffix-matches the slot's own history (prompt + generated
+tokens). If the final n-gram occurred earlier, the tokens that followed
+that earlier occurrence are proposed as the continuation — the
+"prompt lookup" / self-drafting scheme. This is
+
+* deterministic (pure function of the history window, so greedy
+  speculative output can be asserted token-identical to baseline),
+* model-free (no extra params, no extra trace), and
+* jit-able with static shapes (the engine calls one compiled program
+  per tick regardless of which slots match).
+
+The engine treats the drafter as a pluggable hook with the protocol
+
+    drafts, counts = drafter(histories, lengths)
+
+where ``histories`` is ``(num_slots, window)`` int32, LEFT-padded with
+``-1`` (each row right-aligned so the suffix — the match anchor — sits
+at a static offset), ``lengths`` is ``(num_slots,)`` int32 live-token
+counts, and the result is ``(num_slots, k)`` int32 proposals with
+``(num_slots,)`` valid counts. A learned draft model can be dropped in
+by wrapping its own propose step in the same signature.
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["NGramDrafter"]
+
+
+class NGramDrafter:
+    """Propose up to ``k`` continuation tokens by suffix n-gram match.
+
+    For each ``n`` in ``ngrams`` (tried longest-first), the final ``n``
+    tokens of the history are searched for an earlier occurrence inside
+    the last ``window`` tokens. On a hit, the tokens FOLLOWING the
+    matched occurrence are proposed. Among candidate occurrences the
+    drafter prefers ones with at least ``k`` following tokens (a full
+    proposal beats a truncated one), breaking ties by recency —
+    repetitive tails (the high-acceptance regime) then lock onto the
+    most recent period.
+
+    ``window`` bounds the search (and the engine's history-packing
+    cost) — matching is O(window · n) compares, fully vectorized.
+    """
+
+    def __init__(
+        self,
+        k: int,
+        *,
+        window: int = 64,
+        ngrams: Sequence[int] = (3, 2),
+    ):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if window < max(ngrams) + k:
+            raise ValueError(
+                f"window={window} too small for ngrams={tuple(ngrams)} "
+                f"with k={k}"
+            )
+        if any(n < 1 for n in ngrams):
+            raise ValueError(f"ngrams must be >= 1, got {tuple(ngrams)}")
+        self.k = int(k)
+        self.window = int(window)
+        self.ngrams = tuple(int(n) for n in ngrams)
+        self._propose_jit = jax.jit(self.propose)
+
+    # -- pure core (unit-testable, jit-able) ----------------------------
+
+    def _match_n(
+        self, hist: jnp.ndarray, lengths: jnp.ndarray, n: int
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One cascade rung: match the final ``n``-gram.
+
+        Returns ``(found (S,) bool, drafts (S, k), counts (S,))``.
+        """
+        S, W = hist.shape
+        k = self.k
+        m = W - n  # candidate start positions [0, m); i == m is the suffix
+        pattern = hist[:, W - n :]  # (S, n)
+        eq = jnp.ones((S, m), dtype=bool)
+        for j in range(n):
+            eq = eq & (hist[:, j : j + m] == pattern[:, j : j + 1])
+        starts = jnp.arange(m)[None, :]  # (1, m)
+        # a candidate is valid only if its whole n-gram lies inside the
+        # live region (left pad is -1 and can false-match short
+        # histories without this mask)
+        valid = eq & (starts >= (W - lengths)[:, None])
+        # prefer occurrences with >= k following tokens, then recency
+        follow = m - starts  # tokens after the occurrence, >= 1
+        score = jnp.where(valid, starts + jnp.where(follow >= k, W, 0), -1)
+        best = jnp.argmax(score, axis=1)  # (S,)
+        found = jnp.any(valid, axis=1)
+        start = best + n  # first proposed token
+        count = jnp.minimum(k, W - start)
+        idx = jnp.clip(start[:, None] + jnp.arange(k)[None, :], 0, W - 1)
+        drafts = jnp.take_along_axis(hist, idx, axis=1)
+        keep = jnp.arange(k)[None, :] < count[:, None]
+        return found, jnp.where(keep & found[:, None], drafts, 0), jnp.where(
+            found, count, 0
+        )
+
+    def propose(
+        self, histories: jnp.ndarray, lengths: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Pure proposal: ``(S, window)`` histories → ``(S, k)`` drafts
+        + ``(S,)`` counts. Longest n-gram in the cascade wins."""
+        hist = histories.astype(jnp.int32)
+        lengths = jnp.minimum(lengths.astype(jnp.int32), self.window)
+        S = hist.shape[0]
+        drafts = jnp.zeros((S, self.k), jnp.int32)
+        counts = jnp.zeros((S,), jnp.int32)
+        done = jnp.zeros((S,), bool)
+        for n in self.ngrams:
+            found, d_n, c_n = self._match_n(hist, lengths, n)
+            take = found & ~done
+            drafts = jnp.where(take[:, None], d_n, drafts)
+            counts = jnp.where(take, c_n, counts)
+            done = done | found
+        return drafts, counts
+
+    # -- engine-facing numpy wrapper ------------------------------------
+
+    def __call__(
+        self, histories: np.ndarray, lengths: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        drafts, counts = self._propose_jit(
+            jnp.asarray(histories, jnp.int32), jnp.asarray(lengths, jnp.int32)
+        )
+        return np.asarray(drafts), np.asarray(counts)

@@ -108,7 +108,14 @@ class _Slot:
     req: Request
     generated: List[int]
     pos: int  # tokens materialized in the cache for this slot
-    cursor: int = 0  # prompt tokens committed to the cache so far
+    cursor: int = 0  # prefix tokens committed to the cache so far
+    # tokens this slot must prefill before decoding. Normally the
+    # prompt; for a PREEMPTED request re-admitted after its pages were
+    # reclaimed it is prompt + generated[:-1] — the recompute-on-resume
+    # semantics (the last generated token stays unwritten, exactly the
+    # live-slot invariant pos == prompt + generated[:-1]).
+    prefix: List[int] = dataclasses.field(default_factory=list)
+    resumed: bool = False  # re-admitted after preemption mid-decode
     # per-request timeline anchors (perf_counter domain — the SAME
     # clock as `enqueued_at` and `stats()`): slot lease, first sampled
     # token, and the count of mixed ticks that carried this request's
@@ -126,7 +133,7 @@ class _Slot:
 
     @property
     def prefilling(self) -> bool:
-        return self.cursor < len(self.req.prompt)
+        return self.cursor < len(self.prefix)
 
 
 class InferenceEngine:
@@ -204,6 +211,9 @@ class InferenceEngine:
         kv_dtype: Any = None,
         num_pages: Optional[int] = None,
         prefix_sharing: bool = False,
+        spec_k: int = 0,
+        drafter=None,
+        spec_window: int = 64,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -243,6 +253,31 @@ class InferenceEngine:
             )
         self.eos_id = eos_id
         self.sampling = sampling or SamplingParams()
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k > 0:
+            if self.prefill_token_budget is None:
+                raise ValueError(
+                    "speculative decoding rides the chunked mixed step; "
+                    "set prefill_token_budget (chunked mode) to use "
+                    "spec_k"
+                )
+            if self.spec_k + 1 > self.prefill_token_budget:
+                raise ValueError(
+                    f"spec_k={self.spec_k} needs spec_k + 1 <= "
+                    f"prefill_token_budget="
+                    f"{self.prefill_token_budget} chunk rows (the "
+                    f"verified span is the last token plus k drafts)"
+                )
+            if drafter is None:
+                from rocm_apex_tpu.inference.drafting import NGramDrafter
+
+                drafter = NGramDrafter(self.spec_k, window=spec_window)
+        self._drafter = drafter if self.spec_k > 0 else None
+        self._spec_window = int(
+            getattr(self._drafter, "window", spec_window)
+        )
         self.paged = bool(paged)
         self.prefix_sharing = bool(prefix_sharing)
         self._allocator = None
@@ -251,6 +286,15 @@ class InferenceEngine:
         self._prefix_hits = 0
         self._prefix_hit_tokens = 0
         self._page_stalls = 0
+        self._preemptions = 0
+        # preempted-request carryover: request_id -> (generated tokens,
+        # first_token_at, chunk count) restored on re-admission
+        self._preempted: Dict[int, Any] = {}
+        # speculative-decoding accounting: every drafted token ends up
+        # either accepted (emitted) or rolled back
+        self._tokens_drafted = 0
+        self._tokens_accepted = 0
+        self._rollbacks = 0
         if not self.paged:
             if prefix_sharing:
                 raise ValueError("prefix_sharing requires paged=True")
@@ -300,6 +344,7 @@ class InferenceEngine:
         self._prefill_traces = 0
         self._decode_traces = 0
         self._mixed_traces = 0
+        self._commit_traces = 0
         # serving telemetry (read via `stats()`, fed to a
         # monitor.MetricsLogger): monotonic counters + wall-time sums.
         # Latencies include the result fetch — on the tunnel platform
@@ -431,6 +476,65 @@ class InferenceEngine:
             )
             return chunk_tok, dec_tok, cache
 
+        def _mixed_spec(
+            params, cache, chunk_tokens, chunk_slots, chunk_pos,
+            commit_slots, lengths_before, lengths_after, completion_idx,
+            dec_tokens, dec_active, rng,
+        ):
+            """Speculative variant of `_mixed`: the chunk may carry,
+            per decoding slot, that slot's last generated token plus up
+            to k drafted continuations. Those rows score against the
+            slot's committed prefix in the SAME fused trace (they are
+            just budget tokens — no per-k shapes), but their K/V must
+            NOT commit in-trace: a rejected draft can never be unwound
+            from a shared page or an int8 scale that only grows, and
+            the contiguous decode grid's dead-row write would clobber
+            an eagerly-committed row. So every speculative row carries
+            the pad sentinel in ``commit_slots`` (the scatter drops
+            it), the model hands back the packed per-layer chunk K/V,
+            and the host commits exactly the accepted prefix afterwards
+            (`_commit`). One compiled program per engine run:
+            ``mixed_trace_count`` stays 1 at any k."""
+            self._mixed_traces += 1
+            rng_c, rng_d = jax.random.split(rng)
+            cache = cache.replace(lengths=lengths_before)
+            logits_c, cache, chunk_kv = model.apply(
+                params,
+                chunk_tokens[None, :],
+                cache=cache,
+                chunk=(chunk_slots, chunk_pos, commit_slots),
+            )
+            # sample EVERY chunk position: for a draft row the sample
+            # IS the verifier's token — greedy accepts on equality,
+            # and under temperature the sample-vs-draft equality test
+            # is exact rejection sampling for a point-mass drafter
+            chunk_tok = _sample(rng_c, logits_c[0])
+            cache = cache.replace(lengths=lengths_after)
+            budget = chunk_tokens.shape[0]
+            has_comp = completion_idx >= 0
+            first_tok = chunk_tok[
+                jnp.clip(completion_idx, 0, budget - 1)
+            ]
+            dec_tokens = jnp.where(has_comp, first_tok, dec_tokens)
+            dec_active = dec_active | has_comp
+            dec_tok, cache = _decode_body(
+                params, cache, dec_tokens, dec_active, rng_d
+            )
+            return chunk_tok, dec_tok, cache, chunk_kv
+
+        n_layers = len(self.cache.k)
+
+        def _commit(cache, chunk_kv, slots, positions):
+            """Post-verification commit: write the accepted rows'
+            packed chunk K/V into the cache (`write_at` drops the pad
+            sentinel rows). Fixed (budget,) shapes — ONE compiled
+            commit program per engine run."""
+            self._commit_traces += 1
+            ck, cv = chunk_kv
+            for i in range(n_layers):
+                cache = cache.write_at(i, slots, positions, ck[i], cv[i])
+            return cache
+
         # cache buffers are DONATED: the step updates them in place on
         # TPU. CPU (the test platform) cannot donate and would warn on
         # every call, so donation is gated on the backend.
@@ -438,9 +542,15 @@ class InferenceEngine:
         self._prefill_fn = _prefill
         self._decode_fn = _decode_body
         self._mixed_fn = _mixed
+        self._mixed_spec_fn = _mixed_spec
+        self._commit_fn = _commit
         self._prefill_jit = jax.jit(_prefill, donate_argnums=donate)
         self._decode_jit = jax.jit(_decode, donate_argnums=donate)
         self._mixed_jit = jax.jit(_mixed, donate_argnums=donate)
+        self._mixed_spec_jit = jax.jit(_mixed_spec, donate_argnums=donate)
+        self._commit_jit = jax.jit(
+            _commit, donate_argnums=(0,) if on_tpu() else ()
+        )
 
     # ------------------------------------------------------------------
     # public API
@@ -517,7 +627,17 @@ class InferenceEngine:
         ``cow_forks``, ``prefix_hits``/``prefix_hit_tokens`` (admits
         that skipped re-prefilling a stored prefix, and the tokens
         skipped), ``page_stalls`` (tokens deferred by pool
-        backpressure)."""
+        backpressure), ``preemptions`` (slots whose pages were
+        reclaimed under pool deadlock — the request recomputes via
+        chunked prefill on re-admission).
+
+        Speculative decoding (zeros at ``spec_k == 0``):
+        ``tokens_drafted``/``tokens_accepted`` (drafter proposals
+        scheduled into the chunk vs. proposals the verify step
+        emitted), ``acceptance_rate`` (their ratio), ``rollbacks``
+        (spans with at least one rejected draft). Every drafted token
+        is one or the other: ``drafted - accepted`` is exactly the
+        rolled-back row count."""
         prefill_ticks = (
             self._mixed_steps if self.chunked else self._admitted
         )
@@ -561,9 +681,17 @@ class InferenceEngine:
             "prefix_hits": float(self._prefix_hits),
             "prefix_hit_tokens": float(self._prefix_hit_tokens),
             "page_stalls": float(self._page_stalls),
+            "preemptions": float(self._preemptions),
         }
         return {
             **paged_stats,
+            "tokens_drafted": float(self._tokens_drafted),
+            "tokens_accepted": float(self._tokens_accepted),
+            "acceptance_rate": (
+                self._tokens_accepted / self._tokens_drafted
+                if self._tokens_drafted else 0.0
+            ),
+            "rollbacks": float(self._rollbacks),
             "queue_depth": float(self.num_queued),
             "slots_active": float(self.num_active),
             "slot_occupancy": self.num_active / self.num_slots,
@@ -609,6 +737,10 @@ class InferenceEngine:
         self._prefix_hits = 0
         self._prefix_hit_tokens = 0
         self._page_stalls = 0
+        self._preemptions = 0
+        self._tokens_drafted = 0
+        self._tokens_accepted = 0
+        self._rollbacks = 0
 
     def cache_bytes(self) -> int:
         """Device bytes held by the KV cache (pools/buffers + scales +
@@ -811,6 +943,61 @@ class InferenceEngine:
         self._table_dirty = True
         st.borrowed.clear()
 
+    def _preempt_for_pages(self) -> None:
+        """Break a pool deadlock by preempting slots — youngest lease
+        first (least recompute lost, and it frees the most recently
+        allocated pages) — until at least one page is available.
+        Only slots that actually hold table mappings are candidates
+        (preempting a pageless slot frees nothing). A preempted
+        request keeps its generated tokens and timeline anchors in
+        ``_preempted`` and rejoins the HEAD of the queue; re-admission
+        recomputes prompt + generated through the ordinary chunked
+        prefill (determinism: greedy output is unchanged). If every
+        mapped slot is drained and the pool is still empty (pages
+        pinned elsewhere), the original deadlock diagnosis raises."""
+        sentinel = self.cache.num_pages
+        while self._allocator.available < 1:
+            victim, vslot = None, -1
+            for slot, st in enumerate(self._slots):
+                if st is None:
+                    continue
+                if not any(
+                    int(p) != sentinel for p in self._table[slot]
+                ):
+                    continue
+                if victim is None or st.leased_at >= victim.leased_at:
+                    victim, vslot = st, slot
+            # preemption is only productive if ANOTHER in-flight slot
+            # remains to consume the freed pages: the victim rejoins
+            # the queue HEAD, so preempting the sole request would
+            # re-admit it straight into the same wall — a livelock,
+            # not a recovery (the num_pages=1 unservable-pool case)
+            if sum(s is not None for s in self._slots) <= 1:
+                victim = None
+            if victim is None:
+                raise RuntimeError(
+                    "paged KV pool deadlock: every in-flight request "
+                    "is stalled waiting for pages, no decode can run "
+                    "to free any, and no slot holds reclaimable pages "
+                    f"(pages={self.cache.num_pages}, used="
+                    f"{self._allocator.pages_used}); size num_pages "
+                    "for the expected live tokens, or admit less "
+                    "concurrency"
+                )
+            self._release_slot_pages(victim, vslot)
+            self._slots[vslot] = None
+            self._preempted[victim.req.request_id] = (
+                list(victim.generated), victim.first_token_at,
+                victim.chunks,
+            )
+            self._queue.appendleft(victim.req)
+            self._preemptions += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "preempt", track=f"req{victim.req.request_id}",
+                    slot=vslot, generated=len(victim.generated),
+                )
+
     def _guard_capacity(self, active) -> None:
         """The host-side replacement for the cache's silent
         clamp-at-capacity: a live slot about to DECODE at a position
@@ -844,8 +1031,26 @@ class InferenceEngine:
             self._admitted += 1
             self._queue_waits.append(now - req.enqueued_at)
             st = _Slot(
-                req=req, generated=[], pos=0, cursor=0, leased_at=now
+                req=req, generated=[], pos=0, cursor=0,
+                prefix=list(req.prompt), leased_at=now,
             )
+            carried = self._preempted.pop(req.request_id, None)
+            if carried is not None:
+                # preempted request: restore its tokens and recompute
+                # the cache via ordinary chunked prefill of
+                # prompt + generated[:-1] (the last generated token
+                # stays unwritten — the live-slot invariant — so the
+                # slot rejoins the decode grid exactly where it left
+                # off; greedy output is identical to an unpreempted
+                # run). TTFT/chunk anchors carry over: the first token
+                # was already delivered before preemption.
+                generated, first_at, chunks = carried
+                st.generated = list(generated)
+                st.first_token_at = first_at
+                st.chunks = chunks
+                if generated:
+                    st.prefix = list(req.prompt) + list(generated[:-1])
+                    st.resumed = True
             self._slots[slot] = st
             if self._store is not None:
                 pages, matched, partial, key = self._store.match(
@@ -886,71 +1091,150 @@ class InferenceEngine:
         # the segment mask keeps pads talking only to each other
         chunk_slots = np.full((budget,), S, np.int32)
         chunk_pos = np.zeros((budget,), np.int32)
+        # speculative mode only: who COMMITS in-trace. Prefill rows
+        # commit like always; speculative rows keep the pad sentinel
+        # (the host commits their accepted prefix post-verification)
+        commit_slots = np.full((budget,), S, np.int32)
         lengths_before = np.zeros((S,), np.int32)
         lengths_after = np.zeros((S,), np.int32)
         # (slot, chunk index of last prompt token, fed-to-decode flag)
         completions = []
         packed = []  # (slot, tokens, start_pos) — tracer span payload
+        # speculative bookkeeping: (slot, first chunk row, drafted
+        # count, draft tokens, pre-draft position)
+        spec_entries = []
         used = 0
+        prefill_used = 0
+
+        drafts_np = counts_np = None
+        t_d0 = t_d1 = 0.0
+        if self.spec_k > 0:
+            # one batched drafter call per tick, covering every
+            # decoding slot (jitted inside the drafter; numpy in/out)
+            W = self._spec_window
+            hist = np.full((S, W), -1, np.int32)
+            hist_len = np.zeros((S,), np.int32)
+            any_decoding = False
+            for slot, s in enumerate(self._slots):
+                if s is None or not s.generated or s.prefilling:
+                    continue
+                any_decoding = True
+                h = (s.req.prompt + s.generated)[-W:]
+                hist[slot, W - len(h):] = h
+                hist_len[slot] = len(h)
+            if any_decoding:
+                t_d0 = time.perf_counter()
+                drafts_np, counts_np = self._drafter(hist, hist_len)
+                t_d1 = time.perf_counter()
+
         # slot order keeps the packed segment ids non-decreasing (the
-        # varlen kernel's contract)
+        # varlen kernel's contract); a slot contributes either prefill
+        # rows or a speculative span, never both
         for slot in range(S):
             st = self._slots[slot]
             if st is not None:
                 lengths_before[slot] = st.pos
                 lengths_after[slot] = st.pos
-            if st is None or not st.prefilling or used >= budget:
+            if st is None or used >= budget:
                 continue
-            n = min(budget - used, len(st.req.prompt) - st.cursor)
-            if self.prefill_chunk is not None:
-                n = min(n, self.prefill_chunk)
-            if self.paged:
-                # pool backpressure: only tokens whose pages exist (or
-                # could be allocated / CoW-forked) are scheduled; a
-                # starved slot just waits for evictions to free pages
-                n = self._secure_prefill_pages(st, slot, n)
-                if n <= 0:
-                    continue
-            chunk_tokens[used:used + n] = st.req.prompt[
-                st.cursor:st.cursor + n
-            ]
-            chunk_slots[used:used + n] = slot
-            chunk_pos[used:used + n] = np.arange(
-                st.cursor, st.cursor + n
+            if st.prefilling:
+                n = min(budget - used, len(st.prefix) - st.cursor)
+                if self.prefill_chunk is not None:
+                    n = min(n, self.prefill_chunk)
+                if self.paged:
+                    # pool backpressure: only tokens whose pages exist
+                    # (or could be allocated / CoW-forked) are
+                    # scheduled; a starved slot just waits for
+                    # evictions to free pages
+                    n = self._secure_prefill_pages(st, slot, n)
+                    if n <= 0:
+                        continue
+                chunk_tokens[used:used + n] = st.prefix[
+                    st.cursor:st.cursor + n
+                ]
+                chunk_slots[used:used + n] = slot
+                commit_slots[used:used + n] = slot
+                chunk_pos[used:used + n] = np.arange(
+                    st.cursor, st.cursor + n
+                )
+                packed.append((slot, n, st.cursor))
+                st.cursor += n
+                st.pos = st.cursor
+                st.chunks += 1
+                lengths_after[slot] = st.cursor
+                self._prompt_tokens += n
+                if self.paged and self._store is not None:
+                    self._register_full_pages(st, slot)
+                if not st.prefilling and not st.resumed:
+                    # the completing prompt's first sampled token is
+                    # fed straight into the fused decode — UNLESS that
+                    # decode write has nowhere to land: a prompt that
+                    # exactly fills capacity (the old silent
+                    # clamp-at-capacity; the host evicts it right
+                    # after the first token instead) or a paged slot
+                    # whose next page the pool cannot supply yet (it
+                    # decodes on a later tick). A RESUMED (preempted)
+                    # request completing its recomputed prefix emits
+                    # nothing here — its tokens already exist; it
+                    # rejoins the decode grid below this same tick.
+                    fed = st.cursor < self.capacity
+                    if fed and self.paged:
+                        fed = self._ensure_writable(
+                            st, slot, st.cursor // self.cache.page_size
+                        )
+                        if not fed:
+                            self._page_stalls += 1
+                    completions.append((slot, used + n - 1, fed))
+                used += n
+                prefill_used += n
+                continue
+            # ---- speculative span: [last generated token, k drafts].
+            # The last token needs its decode row scored anyway; the
+            # drafts ride the same packed chunk, so acceptance costs
+            # no extra trace. Clamps: drafter confidence, spec_k, the
+            # remaining budget (one row is the last token itself),
+            # capacity (every accepted token + bonus needs a cache
+            # row), and max_new (finishing mid-span is handled, but
+            # drafting past the request's end is wasted budget).
+            if drafts_np is None or not st.generated:
+                continue
+            n = min(
+                int(counts_np[slot]), self.spec_k, budget - used - 1,
+                self.capacity - st.pos - 1,
+                st.req.max_new_tokens - len(st.generated) - 1,
             )
-            packed.append((slot, n, st.cursor))
-            st.cursor += n
-            st.pos = st.cursor
-            st.chunks += 1
-            lengths_after[slot] = st.cursor
-            self._prompt_tokens += n
-            if self.paged and self._store is not None:
-                self._register_full_pages(st, slot)
-            if not st.prefilling:
-                # the completing prompt's first sampled token is fed
-                # straight into the fused decode — UNLESS that decode
-                # write has nowhere to land: a prompt that exactly
-                # fills capacity (the old silent clamp-at-capacity; the
-                # host evicts it right after the first token instead)
-                # or a paged slot whose next page the pool cannot
-                # supply yet (it decodes on a later tick)
-                fed = st.cursor < self.capacity
-                if fed and self.paged:
-                    fed = self._ensure_writable(
-                        st, slot, st.cursor // self.cache.page_size
-                    )
-                    if not fed:
-                        self._page_stalls += 1
-                completions.append((slot, used + n - 1, fed))
-            used += n
+            if n < 1:
+                continue
+            if self.paged and not self._ensure_writable(
+                st, slot, st.pos // self.cache.page_size
+            ):
+                # pool exhausted even for the last token's row: fall
+                # through to the decode grid, which hits the same wall
+                # and stalls the slot for the tick
+                continue
+            drafts = [int(t) for t in drafts_np[slot, :n]]
+            chunk_tokens[used] = st.generated[-1]
+            chunk_tokens[used + 1:used + 1 + n] = drafts
+            chunk_slots[used:used + n + 1] = slot
+            chunk_pos[used:used + n + 1] = np.arange(
+                st.pos, st.pos + n + 1
+            )
+            spec_entries.append((slot, used, n, drafts, st.pos))
+            self._tokens_drafted += n
+            used += n + 1
 
         # decode grid: slots whose prompt completed in an EARLIER tick
         # (a slot finishing prefill this tick gets its first token from
-        # the chunk logits below and starts decoding next tick)
+        # the chunk logits below and starts decoding next tick; a slot
+        # with a speculative span this tick advances via the accept
+        # walk instead)
         active = np.array(
-            [s is not None and bool(s.generated) for s in self._slots],
+            [s is not None and bool(s.generated) and not s.prefilling
+             for s in self._slots],
             dtype=bool,
         )
+        for slot, _, _, _, _ in spec_entries:
+            active[slot] = False
         self._guard_capacity(active)
         if self.paged:
             for slot, st in enumerate(self._slots):
@@ -974,24 +1258,79 @@ class InferenceEngine:
         for slot, idx, fed in completions:
             completion_idx[slot] = idx if fed else -1
         if self.paged:
-            self._push_table()
             if (
                 used == 0 and not active.any() and completions == []
                 and self.has_work()
             ):
-                raise RuntimeError(
-                    "paged KV pool deadlock: every in-flight request "
-                    "is stalled waiting for pages and no decode can "
-                    "run to free any (pages="
-                    f"{self.cache.num_pages}, used="
-                    f"{self._allocator.pages_used}); size num_pages "
-                    "for the expected live tokens, or admit less "
-                    "concurrency"
-                )
+                # pool deadlock: every in-flight request is stalled
+                # waiting for pages and no decode can run to free any.
+                # Preempt-and-requeue (the vLLM recompute policy)
+                # instead of stalling forever or raising: the youngest
+                # page-holding slot gives its pages back and its
+                # request rejoins the queue head; on re-admission its
+                # prompt + generated tokens are recomputed through the
+                # ordinary chunked prefill.
+                self._preempt_for_pages()
+            self._push_table()
 
         chunk_out = None
         dec_out = None
-        if used > 0:
+        chunk_kv = None
+        spec_t0 = spec_t1 = 0.0
+        if self.spec_k > 0 and (used > 0 or active.any()):
+            # speculative engines ALWAYS run the (single) spec mixed
+            # program, even on draft-free ticks: the decode-only fast
+            # path reads device-resident lengths, which the host-side
+            # accept walk outruns — here the host cursors ride in as
+            # arguments every tick, and one program means
+            # mixed_trace_count == 1 at any k
+            self._rng, rng = jax.random.split(self._rng)
+            t0 = time.perf_counter()
+            with profiler.annotate(
+                "inference/mixed_step",
+                chunk_tokens=used, decodes=int(active.sum()),
+                drafted=sum(e[2] for e in spec_entries),
+            ):
+                chunk_tok, dec_tok, self.cache, chunk_kv = (
+                    self._mixed_spec_jit(
+                        self.params, self.cache,
+                        jnp.asarray(chunk_tokens),
+                        jnp.asarray(chunk_slots),
+                        jnp.asarray(chunk_pos),
+                        jnp.asarray(commit_slots),
+                        jnp.asarray(lengths_before),
+                        jnp.asarray(lengths_after),
+                        jnp.asarray(completion_idx),
+                        jnp.asarray(dec_tokens),
+                        jnp.asarray(active), rng,
+                    )
+                )
+            # ONE batched value fetch per tick (= the device sync);
+            # chunk_kv stays on device for the commit program
+            chunk_out, dec_out = jax.device_get((chunk_tok, dec_tok))
+            t1 = time.perf_counter()
+            spec_t0, spec_t1 = t0, t1
+            if prefill_used > 0:
+                self._prefill_seconds += t1 - t0
+                self._mixed_steps += 1
+            else:
+                self._decode_seconds += t1 - t0
+            if active.any() or completions or spec_entries:
+                self._decode_steps += 1
+            if self.tracer.enabled:
+                self.tracer.add_span(
+                    "mixed_step", t0, t1, track="engine",
+                    chunk_tokens=used, decodes=int(active.sum()),
+                    drafted=sum(e[2] for e in spec_entries),
+                )
+                for slot, n, start_pos in packed:
+                    st = self._slots[slot]
+                    self.tracer.add_span(
+                        "prefill_chunk", t0, t1,
+                        track=f"req{st.req.request_id}",
+                        tokens=n, start_pos=start_pos, slot=slot,
+                    )
+        elif used > 0:
             self._rng, rng = jax.random.split(self._rng)
             t0 = time.perf_counter()
             with profiler.annotate(
@@ -1083,6 +1422,90 @@ class InferenceEngine:
                 done = self._finish_reason(st)
                 if done is not None:
                     finished.append(self._evict(slot, st, done))
+
+        # ---- speculative accept walk. Every packed span was sampled
+        # under the target model (row j conditioned on the drafts before
+        # it), so for the point-mass drafter the exact rejection rule
+        # (arXiv 2302.01318) degenerates to: accept draft j iff the
+        # model's own sample at row j equals it; the first disagreeing
+        # row's sample is the corrected "bonus" token — m accepted
+        # drafts always yield m+1 emitted tokens. Rejected rows simply
+        # never commit: their K/V exists only in the trace's packed
+        # per-layer output, so rollback is "don't write", not "undo" —
+        # shared pages and int8 scales are untouchable by construction.
+        if spec_entries:
+            any_commit = False
+            commit_np = np.full((budget,), S, np.int32)
+            commit_pos_np = np.zeros((budget,), np.int32)
+            for slot, r0, n, drafts, pos0 in spec_entries:
+                st = self._slots[slot]
+                out = chunk_out[r0:r0 + n + 1]
+                m = 0
+                while m < n and int(out[m]) == drafts[m]:
+                    m += 1
+                if self.paged and m > 0:
+                    # accepted tokens become cache writes: clamp the
+                    # accept length to pages the pool can actually
+                    # supply (CoW-forking shared ones as usual)
+                    ps = self.cache.page_size
+                    for j in range(1, m + 1):
+                        if not self._ensure_writable(
+                            st, slot, (pos0 + j) // ps
+                        ):
+                            self._page_stalls += 1
+                            m = j - 1
+                            break
+                emit = drafts[:m] + [int(out[m])]
+                accepted = 0
+                done = None
+                for i, tok in enumerate(emit):
+                    st.pos += 1
+                    st.generated.append(int(tok))
+                    self._generated_tokens += 1
+                    if i < m:
+                        accepted += 1
+                        self._tokens_accepted += 1
+                    done = self._finish_reason(st)
+                    if done is not None:
+                        break
+                if n - accepted > 0:
+                    self._rollbacks += 1
+                if self.tracer.enabled:
+                    track = f"req{st.req.request_id}"
+                    self.tracer.add_span(
+                        "draft", t_d0, t_d1, track=track, tokens=n,
+                    )
+                    self.tracer.add_span(
+                        "verify", spec_t0, spec_t1, track=track,
+                        drafted=n, accepted=accepted, slot=slot,
+                    )
+                    if n - accepted > 0:
+                        self.tracer.instant(
+                            "rollback", track=track,
+                            rejected=n - accepted,
+                        )
+                if done is not None:
+                    # evicted slot: its uncommitted rows just die with
+                    # the lease (paged pages are derefed by the evict)
+                    finished.append(self._evict(slot, st, done))
+                    continue
+                # commit the span's written prefix: the last token's
+                # row r0 (it was never in the cache — the scatter
+                # dropped it in-trace) plus the m accepted draft rows.
+                # The bonus token is NOT written: it is the slot's new
+                # trailing unwritten token, exactly like normal decode.
+                commit_np[r0:r0 + m + 1] = slot
+                commit_pos_np[r0:r0 + m + 1] = np.arange(
+                    pos0, pos0 + m + 1
+                )
+                any_commit = True
+            if any_commit:
+                if self.paged:
+                    self._push_table()  # CoW forks from the clamp above
+                self.cache = self._commit_jit(
+                    self.cache, chunk_kv,
+                    jnp.asarray(commit_np), jnp.asarray(commit_pos_np),
+                )
         return finished
 
     def _step_whole(self) -> List[GenerationResult]:
@@ -1117,7 +1540,8 @@ class InferenceEngine:
             self._prompt_tokens += len(req.prompt)
             self._slots[slot] = _Slot(
                 req=req, generated=[], pos=len(req.prompt),
-                cursor=len(req.prompt), leased_at=t_admit, chunks=1,
+                cursor=len(req.prompt), prefix=list(req.prompt),
+                leased_at=t_admit, chunks=1,
             )
             pending.append((slot, tok))
         if pending:

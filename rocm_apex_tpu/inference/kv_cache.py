@@ -148,7 +148,14 @@ class KVCache:
         out-of-range slot id (>= num_slots) and are DROPPED by the
         scatter (``mode="drop"``), so a partially filled chunk never
         touches live rows. Does not advance ``lengths`` — the engine
-        commits cursors once per tick."""
+        commits cursors once per tick.
+
+        The drop semantics double as speculative decoding's deferred
+        commit: draft rows ride the chunk with the pad sentinel (so
+        the in-trace scatter skips them), and the engine replays the
+        SAME ``write_at`` post-verification with only the accepted
+        rows' real slot ids — rollback is "never written", not
+        "undone"."""
         k = list(self.k)
         v = list(self.v)
         k[layer] = self.k[layer].at[slots, positions].set(

@@ -473,7 +473,12 @@ class PagedKVCache:
     ) -> "PagedKVCache":
         """`KVCache.write_at` semantics (packed chunk at explicit
         per-token destinations; pad tokens carry slot id >= num_slots
-        and drop) routed through the page table."""
+        and drop) routed through the page table. The drop path is
+        what lets speculative drafts defer their commit: a rejected
+        draft row is simply never scattered, so it can never have
+        touched a shared (CoW) page or grown an int8 page scale —
+        the engine's post-verification commit re-issues only the
+        accepted rows."""
         return self._scatter(layer, slots, positions, k_new, v_new)
 
     def advance(self, t: int, active: Optional[jnp.ndarray] = None

@@ -493,6 +493,18 @@ class ParallelAttention(nn.Module):
             # table / page size / per-(page, head) int8 scales — the
             # writes scatter through the table and the reads gather
             # through it (ops/paging.py + the paged flash kernels).
+            #
+            # SPECULATIVE mode: a 3-tuple chunk (slot_ids, positions,
+            # commit_slots) splits "who attends" from "who commits".
+            # Attention masking still follows `chunk_slots`, but the
+            # K/V scatter routes through `commit_slots` — speculative
+            # rows carry the num_slots sentinel there, so their K/V
+            # never lands in the cache in-trace (the host commits the
+            # accepted prefix afterwards via KVCache.write_at, which is
+            # what keeps rejected drafts away from shared pages and
+            # int8 scales). Each layer then also returns its packed
+            # chunk-local (kq, vq) so the host-side commit has the
+            # bytes to write.
             if x.shape[0] != 1:
                 raise ValueError(
                     "chunked prefill takes one packed stream "
@@ -500,7 +512,9 @@ class ParallelAttention(nn.Module):
                 )
             k_buf, v_buf, lengths = cache[:3]
             paged = cache[3] if len(cache) > 3 else None
-            chunk_slots, chunk_pos = chunk
+            spec = len(chunk) == 3
+            chunk_slots, chunk_pos = chunk[0], chunk[1]
+            commit_slots = chunk[2] if spec else chunk_slots
             budget = x.shape[1]
             q, k, v = jnp.split(qkv, 3, axis=-1)  # (1, budget, nh, hd)
             qq, kq, vq = q[0], k[0], v[0]  # (budget, nh, hd)
@@ -510,10 +524,10 @@ class ParallelAttention(nn.Module):
                 # scatter this chunk's K/V at per-token (slot, position)
                 # destinations (in place under jit with donated
                 # buffers); out-of-range pad slots are dropped
-                k_buf = k_buf.at[chunk_slots, chunk_pos].set(
+                k_buf = k_buf.at[commit_slots, chunk_pos].set(
                     kq.astype(k_buf.dtype), mode="drop"
                 )
-                v_buf = v_buf.at[chunk_slots, chunk_pos].set(
+                v_buf = v_buf.at[commit_slots, chunk_pos].set(
                     vq.astype(v_buf.dtype), mode="drop"
                 )
                 new_kv = (k_buf, v_buf)
@@ -529,19 +543,19 @@ class ParallelAttention(nn.Module):
                 if paged["k_scale"] is not None:
                     k_buf, k_sc = quantized_paged_scatter(
                         k_buf, paged["k_scale"], table,
-                        chunk_slots, chunk_pos, kq,
+                        commit_slots, chunk_pos, kq,
                     )
                     v_buf, v_sc = quantized_paged_scatter(
                         v_buf, paged["v_scale"], table,
-                        chunk_slots, chunk_pos, vq,
+                        commit_slots, chunk_pos, vq,
                     )
                     new_kv = (k_buf, v_buf, k_sc, v_sc)
                 else:
                     k_buf = paged_scatter(
-                        k_buf, table, chunk_slots, chunk_pos, kq
+                        k_buf, table, commit_slots, chunk_pos, kq
                     )
                     v_buf = paged_scatter(
-                        v_buf, table, chunk_slots, chunk_pos, vq
+                        v_buf, table, commit_slots, chunk_pos, vq
                     )
                     new_kv = (k_buf, v_buf)
             slot_c = jnp.clip(chunk_slots, 0, num_slots - 1)
@@ -576,15 +590,60 @@ class ParallelAttention(nn.Module):
                     onehot,
                 ) * scale
                 col = jnp.arange(capacity)[None, None, :]
-                bound = (chunk_pos + 1)[:, None, None]
-                scores = jnp.where(col < bound, scores, -jnp.inf)
-                probs = jax.nn.softmax(scores, axis=-1)
-                ctx_t = jnp.einsum(
-                    "tnc,scnd,ts->tnd",
-                    probs,
-                    vc_read.astype(jnp.float32),
-                    onehot,
-                )
+                if not spec:
+                    bound = (chunk_pos + 1)[:, None, None]
+                    scores = jnp.where(col < bound, scores, -jnp.inf)
+                    probs = jax.nn.softmax(scores, axis=-1)
+                    ctx_t = jnp.einsum(
+                        "tnc,scnd,ts->tnd",
+                        probs,
+                        vc_read.astype(jnp.float32),
+                        onehot,
+                    )
+                else:
+                    # speculative rows are NOT in the cache (their
+                    # scatter is deferred to the host commit), so the
+                    # one-pass read above can only cover each slot's
+                    # COMMITTED prefix [0, lengths). Intra-chunk
+                    # predecessors + self come straight from the packed
+                    # projections — the same two-piece structure the
+                    # flash chunk path always had — under ONE softmax
+                    # over the concatenated (prefix ++ chunk) axis.
+                    bound = lengths[slot_c][:, None, None]
+                    scores = jnp.where(col < bound, scores, -jnp.inf)
+                    if k_sc is None:
+                        # round-trip through the cache dtype so the
+                        # intra-chunk read is byte-identical to reading
+                        # scattered rows back (greedy parity with the
+                        # non-speculative path); int8 pages dequantize
+                        # with data-dependent scales, so there the raw
+                        # projection is the faithful value
+                        kb = kq.astype(k_buf.dtype).astype(jnp.float32)
+                        vb = vq.astype(v_buf.dtype).astype(jnp.float32)
+                    else:
+                        kb = kq.astype(jnp.float32)
+                        vb = vq.astype(jnp.float32)
+                    scores_b = jnp.einsum(
+                        "tnd,jnd->tnj", qq.astype(jnp.float32), kb
+                    ) * scale
+                    intra = (
+                        chunk_slots[None, :] == chunk_slots[:, None]
+                    ) & (chunk_pos[None, :] <= chunk_pos[:, None])
+                    scores_b = jnp.where(
+                        intra[:, None, :], scores_b, -jnp.inf
+                    )
+                    probs = jax.nn.softmax(
+                        jnp.concatenate([scores, scores_b], axis=-1),
+                        axis=-1,
+                    )
+                    ctx_t = jnp.einsum(
+                        "tnc,scnd,ts->tnd",
+                        probs[..., :capacity],
+                        vc_read.astype(jnp.float32),
+                        onehot,
+                    ) + jnp.einsum(
+                        "tnj,jnd->tnd", probs[..., capacity:], vb
+                    )
             elif paged is not None:
                 # flash paged: the composed op runs the intra-chunk
                 # segments kernel + the page-table-gather prefix read
@@ -657,6 +716,11 @@ class ParallelAttention(nn.Module):
                     w_a[..., None] * o_a.astype(jnp.float32)
                     + w_b[..., None] * o_b.astype(jnp.float32)
                 ) / (w_a + w_b)[..., None]
+            if spec:
+                # hand the packed chunk K/V to the host: the engine's
+                # post-verify commit writes the ACCEPTED rows (and only
+                # those) through KVCache.write_at
+                new_kv = new_kv + (kq, vq)
             ctx = ctx_t.astype(cfg.dtype).reshape(
                 1, budget, nh_local * hd
             )
@@ -1137,6 +1201,7 @@ class ParallelTransformer(nn.Module):
         delta = None
         new_k, new_v = [], []
         new_ks, new_vs = [], []
+        chunk_k, chunk_v = [], []  # speculative chunk: per-layer (kq, vq)
         # paged caches (inference/paging.py PagedKVCache — duck-typed:
         # this module never imports it) route the per-layer view with a
         # 4th element carrying the page table / page size / int8 scales
@@ -1166,6 +1231,12 @@ class ParallelTransformer(nn.Module):
                     x, attention_mask, deterministic, None, False,
                     layer_cache, chunk,
                 )
+                if chunk is not None and len(chunk) == 3:
+                    # speculative chunk: each layer's trailing (kq, vq)
+                    # is the packed chunk K/V for the host-side commit
+                    chunk_k.append(kv_i[-2])
+                    chunk_v.append(kv_i[-1])
+                    kv_i = kv_i[:-2]
                 new_k.append(kv_i[0])
                 new_v.append(kv_i[1])
                 if len(kv_i) > 2:  # quantized paged: updated scales
@@ -1220,6 +1291,10 @@ class ParallelTransformer(nn.Module):
                 # offsets, a variable number per slot — the ENGINE
                 # commits the new cursors once per tick (lengths are
                 # untouched here)
+                if len(chunk) == 3:
+                    return x, cache.replace(**repl), (
+                        tuple(chunk_k), tuple(chunk_v)
+                    )
                 return x, cache.replace(**repl)
             # every layer wrote at the same offsets; advance ONCE, for
             # all slots (the engine masks inactive slots afterwards).
@@ -1347,6 +1422,17 @@ class GPTModel(nn.Module):
     cache read on the flash path). ``lengths`` are NOT advanced (the
     serving engine commits cursors once per tick); padding tokens
     carry slot id == num_slots. See docs/inference.md.
+
+    ``chunk=(slot_ids, positions, commit_slots)`` — the 3-tuple form —
+    runs the SPECULATIVE chunk: attention follows ``slot_ids`` as
+    before, but the K/V scatter routes through ``commit_slots``
+    (speculative rows carry the ``num_slots`` sentinel there, so
+    their K/V never commits in-trace), each slot's cache read is
+    bounded by its ``lengths`` entry, and the call returns
+    ``(logits, cache, (chunk_k, chunk_v))`` where the extra element
+    holds each layer's packed chunk K/V for the engine's
+    post-verification accepted-prefix commit. See
+    docs/inference.md#speculative-decoding.
     """
 
     cfg: GPTConfig
@@ -1396,9 +1482,16 @@ class GPTModel(nn.Module):
                         + jnp.arange(tokens.shape[1])[None, :]
                     )
             x = self.embedding(tokens, position_ids, deterministic)
-            x, cache = self.transformer(
+            out = self.transformer(
                 x, deterministic=deterministic, cache=cache, chunk=chunk
             )
+            if chunk is not None and len(chunk) == 3:
+                # speculative chunk: also return the per-layer packed
+                # chunk K/V (tuple of k, tuple of v) for the host-side
+                # accepted-prefix commit
+                x, cache, chunk_kv = out
+                return self.embedding.attend(x), cache, chunk_kv
+            x, cache = out
             return self.embedding.attend(x), cache
         x = self.embedding(tokens, position_ids, deterministic)
         x = self.transformer(x, deterministic=deterministic)

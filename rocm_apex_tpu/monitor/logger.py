@@ -161,9 +161,15 @@ class MetricsLogger:
             "admitted", "evicted", "prompt_tokens",
             "generated_tokens", "decode_steps", "mixed_steps",
             # the paged cache's monotonic counters (CoW forks, prefix
-            # admissions/tokens, pool-backpressure stalls)
+            # admissions/tokens, pool-backpressure stalls, deadlock
+            # preemptions)
             "cow_forks", "prefix_hits", "prefix_hit_tokens",
-            "page_stalls",
+            "page_stalls", "preemptions",
+            # speculative-decoding counters: drafted/accepted totals
+            # flush as last value; acceptance_rate is their running
+            # ratio and follows them
+            "tokens_drafted", "tokens_accepted", "acceptance_rate",
+            "rollbacks",
         ),
         timers: Optional[Timers] = None,
         memory_stats: bool = True,

@@ -59,11 +59,35 @@ CASES = [
          # the 12-token prompts, plus the per-request fairness cap
          "--token-budget", "5", "--prefill-chunk", "4"],
     ),
+    (
+        "gpt_train.py --dist-opt",
+        ["--num-layers", "2", "--hidden-size", "64",
+         "--num-attention-heads", "4", "--seq-length", "32",
+         "--max-position-embeddings", "32", "--micro-batch-size", "2",
+         "--train-iters", "2", "--log-interval", "1",
+         # ZeRO path: TP=2 x DP=4 so the optimizer both shards over
+         # data AND coexists with tensor-parallel param shards
+         "--tensor-model-parallel-size", "2", "--dist-opt"],
+    ),
+    (
+        "generate_gpt.py --spec-k",
+        ["--num-layers", "2", "--hidden-size", "64",
+         "--num-attention-heads", "4", "--max-seq-len", "64",
+         "--max-prompt-len", "12", "--num-slots", "2",
+         "--num-requests", "5", "--max-new-tokens", "6",
+         # speculative decoding: budget = num_slots*(k+1) keeps both
+         # slots drafting at full rate; the script's own trace-count
+         # check asserts the one-program contract holds with spec on
+         "--token-budget", "6", "--spec-k", "2"],
+    ),
 ]
 
 
 @pytest.mark.parametrize("script,args", CASES, ids=[c[0] for c in CASES])
 def test_example_runs(script, args):
+    # case ids may carry a " --flag" suffix to distinguish variant
+    # runs of one script; only the first token is the filename
+    script = script.split()[0]
     out = subprocess.run(
         [sys.executable, str(REPO / "examples" / script), *args],
         capture_output=True,
