@@ -1079,7 +1079,8 @@ def bench_ln():
 def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
          remat: bool = False, loss: str = "fused",
          seq_parallel: bool = False, collective_matmul: bool = False,
-         audit: bool = False, dist_opt: bool = False):
+         audit: bool = False, dist_opt: bool = False,
+         packed_update: bool = False):
     if loss not in ("fused", "naive"):
         raise SystemExit(f"--loss must be 'fused' or 'naive', got {loss!r}")
     if collective_matmul and not seq_parallel:
@@ -1090,6 +1091,12 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         )
     if dist_opt and loss != "fused":
         raise SystemExit("--dist-opt measures the fused-loss path")
+    if packed_update and (dist_opt or seq_parallel):
+        raise SystemExit(
+            "--packed-update A/Bs the replicated optimizer step; the "
+            "ZeRO path (--dist-opt) is always packed and the tp series "
+            "keys on the model sharding"
+        )
     on_tpu = jax.default_backend() == "tpu"
     # tp-axis A/B: shard the model over ALL visible chips on the
     # tensor axis with sequence-parallel activations between the TP
@@ -1282,44 +1289,54 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
     sstate = scaler.init()
     rng0 = _dropout_rng0(dropout, on_tpu)
 
-    def one_step(carry, _):
-        state, sstate, rng = carry
-        rng, step_rng = jax.random.split(rng)
+    def make_one_step(opt):
+        # parameterized over the optimizer so --packed-update can run
+        # the identical step with PackedOptimizerStep (same
+        # init/model/step_and_probe surface as MixedPrecisionAdam)
+        def one_step(carry, _):
+            state, sstate, rng = carry
+            rng, step_rng = jax.random.split(rng)
 
-        def loss_fn(params):
-            rngs = {"dropout": step_rng} if dropout > 0.0 else None
-            if loss == "naive":
-                # A/B reference: materialize the full (b, s, vocab)
-                # logits, cast fp32, optax CE — the path the model no
-                # longer ships (fused_lm_head + in-op mean reduction)
-                import optax
+            def loss_fn(params):
+                rngs = {"dropout": step_rng} if dropout > 0.0 else None
+                if loss == "naive":
+                    # A/B reference: materialize the full (b, s, vocab)
+                    # logits, cast fp32, optax CE — the path the model
+                    # no longer ships (fused_lm_head + in-op mean
+                    # reduction)
+                    import optax
 
-                logits = model.apply(
-                    params, tokens,
+                    logits = model.apply(
+                        params, tokens,
+                        deterministic=dropout == 0.0, rngs=rngs,
+                    )
+                    ce = optax.softmax_cross_entropy_with_integer_labels(
+                        logits.astype(jnp.float32), labels
+                    ).mean()
+                    return ce * scaler.loss_scale(sstate)
+                # fused linear-CE head, mean reduction inside the op:
+                # the loss cotangent is a scalar, so the head's dx/dW
+                # finish in the forward pass and no logits ever hit HBM
+                mean = model.apply(
+                    params, tokens, labels=labels, loss_reduction="mean",
                     deterministic=dropout == 0.0, rngs=rngs,
                 )
-                ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits.astype(jnp.float32), labels
-                ).mean()
-                return ce * scaler.loss_scale(sstate)
-            # fused linear-CE head, mean reduction inside the op: the
-            # loss cotangent is a scalar, so the head's dx/dW finish
-            # in the forward pass and no logits ever hit HBM
-            mean = model.apply(
-                params, tokens, labels=labels, loss_reduction="mean",
-                deterministic=dropout == 0.0, rngs=rngs,
-            )
-            return mean * scaler.loss_scale(sstate)
+                return mean * scaler.loss_scale(sstate)
 
-        scaled, grads = jax.value_and_grad(loss_fn)(state.model)
-        inv_scale = 1.0 / scaler.loss_scale(sstate)
-        # probe rides the update pass (and fuses into the dW matmuls);
-        # a standalone all_finite(grads) would re-read every gradient
-        state2, found_inf = opt.step_and_probe(
-            state, grads, grad_scale=inv_scale
-        )
-        sstate2, _ = scaler.update(sstate, found_inf)
-        return (state2, sstate2, rng), scaled * inv_scale
+            scaled, grads = jax.value_and_grad(loss_fn)(state.model)
+            inv_scale = 1.0 / scaler.loss_scale(sstate)
+            # probe rides the update pass (and fuses into the dW
+            # matmuls); a standalone all_finite(grads) would re-read
+            # every gradient
+            state2, found_inf = opt.step_and_probe(
+                state, grads, grad_scale=inv_scale
+            )
+            sstate2, _ = scaler.update(sstate, found_inf)
+            return (state2, sstate2, rng), scaled * inv_scale
+
+        return one_step
+
+    one_step = make_one_step(opt)
 
     def local_runN(state, sstate, rng):
         # unroll=2 halves the while-loop bookkeeping between steps
@@ -1492,6 +1509,110 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         ),
     )
 
+    if packed_update:
+        # ---- packed-buffer optimizer A/B (--packed-update): rerun the
+        # IDENTICAL train loop with PackedOptimizerStep (one fused
+        # unscale+probe+Adam pass per dtype buffer, masters/moments
+        # held packed in the carry) against the MixedPrecisionAdam
+        # baseline just measured, then isolate the update phase and the
+        # traced program size so the three claims — step time, update
+        # share, O(dtype-groups) equations — each get their own number.
+        from rocm_apex_tpu.optimizers.packed import PackedOptimizerStep
+
+        popt = PackedOptimizerStep("adam", 1e-4, weight_decay=0.01)
+        pstate = popt.init(params32)
+        one_step_p = make_one_step(popt)
+
+        def local_runN_p(state, sstate, rng):
+            (state, sstate, rng), losses = jax.lax.scan(
+                one_step_p, (state, sstate, rng), None, length=iters,
+                unroll=2,
+            )
+            return state, sstate, rng, losses
+
+        runN_p = jax.jit(local_runN_p)
+        pstate, psstate, prng, plosses = runN_p(
+            pstate, scaler.init(), rng0
+        )
+        ploss_val = float(plosses[-1])  # warmup + sync
+        # interleaved best-of-5: tree and packed alternate inside the
+        # same wall-clock window so host-load drift (which dominates a
+        # ~600 ms CPU step, observed +-10% run to run against a true
+        # per-step delta under 0.1%) cancels instead of landing on one
+        # side; both sides get the same sample count from the same
+        # window, and best-of estimates each program's quiet-host time
+        dt_tree = float("inf")
+        dt_packed = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            state, sstate, rng0, losses = runN(state, sstate, rng0)
+            float(losses[-1])
+            dt_tree = min(dt_tree, (time.perf_counter() - t0) / iters)
+            t0 = time.perf_counter()
+            pstate, psstate, prng, plosses = runN_p(
+                pstate, psstate, prng
+            )
+            ploss_val = float(plosses[-1])
+            dt_packed = min(dt_packed, (time.perf_counter() - t0) / iters)
+
+        # update-phase share: the bare optimizer step on fixed grads
+        # (bench_optim idiom), tree vs packed, outside the fwd/bwd
+        grads_fix = jax.tree_util.tree_map(
+            lambda p: (p * 1e-3 + 1e-5).astype(cfg.dtype), params32
+        )
+
+        def upd_tree(carry):
+            s, g = carry
+            s2, _ = opt.step_and_probe(s, g, grad_scale=1.0)
+            return s2, g
+
+        def upd_packed(carry):
+            s, g = carry
+            s2, _ = popt.step_and_probe(s, g, grad_scale=1.0)
+            return s2, g
+
+        ms_upd_tree = _timed_scan(
+            upd_tree, (opt.init(params32), grads_fix), iters
+        )
+        ms_upd_packed = _timed_scan(
+            upd_packed, (popt.init(params32), grads_fix), iters
+        )
+
+        # traced-program size of the bare update (monitor/audit.py
+        # equation count): the packed step is O(dtype-groups), the
+        # tree step O(leaves) — the fusion-granularity claim, printed
+        # here and pinned by tests/L0/test_packed_optimizers.py
+        rep_tree = monitor.audit(
+            lambda s, g: opt.step_and_probe(s, g, grad_scale=1.0),
+            opt.init(params32), grads_fix,
+        )
+        rep_packed = monitor.audit(
+            lambda s, g: popt.step_and_probe(s, g, grad_scale=1.0),
+            popt.init(params32), grads_fix,
+        )
+        n_leaves = len(jax.tree_util.tree_leaves(params32))
+        print(
+            f"packed A/B: step {dt_packed*1000:.1f} ms vs tree "
+            f"{dt_tree*1000:.1f} ms; update phase {ms_upd_packed:.2f} ms "
+            f"({100.0 * ms_upd_packed / (dt_packed * 1000):.1f}% of "
+            f"step) vs tree {ms_upd_tree:.2f} ms "
+            f"({100.0 * ms_upd_tree / (dt_tree * 1000):.1f}%); update "
+            f"equations {int(rep_packed.eqn_count)} (packed, "
+            f"{n_leaves}-leaf tree) vs {int(rep_tree.eqn_count)} "
+            f"(tree-fused)",
+            file=sys.stderr,
+        )
+        _report(
+            f"gpt_train_tokens_per_sec_per_chip{suffix}_packed",
+            batch * seq / dt_packed, "tokens/s", dt_tree / dt_packed,
+            f"step={dt_packed*1000:.1f}ms loss={ploss_val:.4f} "
+            f"update={ms_upd_packed:.2f}ms "
+            f"(tree {ms_upd_tree:.2f}ms) eqns={int(rep_packed.eqn_count)} "
+            f"(tree {int(rep_tree.eqn_count)}, {n_leaves} leaves) "
+            f"vs_baseline = tree_step/packed_step "
+            f"backend={jax.default_backend()}",
+        )
+
 
 if __name__ == "__main__":
     # driver contract: plain `python bench.py` = the flagship GPT line.
@@ -1547,6 +1668,8 @@ if __name__ == "__main__":
             kwargs["spec_k"] = int(a.split("=", 1)[1])
         elif a == "--dist-opt":
             kwargs["dist_opt"] = True
+        elif a == "--packed-update":
+            kwargs["packed_update"] = True
         elif a.startswith("--fused="):
             kwargs["fused"] = bool(int(a.split("=", 1)[1]))
         elif a.startswith("--"):
@@ -1590,6 +1713,8 @@ if __name__ == "__main__":
         raise SystemExit("--spec-k must be >= 0")
     if "dist_opt" in kwargs and which != "gpt":
         raise SystemExit("--dist-opt applies to the gpt bench")
+    if "packed_update" in kwargs and which != "gpt":
+        raise SystemExit("--packed-update applies to the gpt bench")
     if kwargs.get("dist_opt") and kwargs.get("seq_parallel"):
         raise SystemExit(
             "--dist-opt shards the optimizer over the data axis; it "

@@ -42,6 +42,7 @@ from rocm_apex_tpu.monitor import (
     tree_norm,
 )
 from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
+from rocm_apex_tpu.optimizers.packed import PackedOptimizerStep
 from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.transformer.amp import GradScaler
 from rocm_apex_tpu.transformer.testing import parse_args
@@ -69,8 +70,18 @@ def _observability_args(parser):
              "(contrib.optimizers.distributed_fused_adam: "
              "reduce-scatter grads -> 1/dp-sharded update -> "
              "allgather params, the reference DistributedFusedAdam "
-             "semantics); replaces the mixed-precision scaler path "
-             "with plain fp32, so loss_scale reads 1 in the metrics",
+             "semantics); composes with the dynamic loss scaler — the "
+             "unscale + found_inf probe runs fused on the packed grad "
+             "buffers before the reduce-scatter, and the scaler's "
+             "halve/grow logic reads the optimizer-reported flag",
+    )
+    g2.add_argument(
+        "--packed-update", action="store_true",
+        help="run the optimizer step over packed dtype-group buffers "
+             "(optimizers.PackedOptimizerStep): one-pass unscale + "
+             "found_inf + Adam update per dtype buffer, O(dtype-groups) "
+             "traced equations instead of O(leaves); ignored under "
+             "--dist-opt (the ZeRO path is always packed)",
     )
     return parser
 
@@ -115,12 +126,20 @@ def main():
         ),
     )
     model = GPTModel(cfg)
-    opt = MixedPrecisionAdam(args.lr, weight_decay=args.weight_decay)
+    if args.packed_update and not args.dist_opt:
+        opt = PackedOptimizerStep(
+            "adam", args.lr, weight_decay=args.weight_decay
+        )
+    else:
+        opt = MixedPrecisionAdam(args.lr, weight_decay=args.weight_decay)
     scaler = GradScaler(axis_names=(parallel_state.TENSOR_AXIS,))
     dist = (
         distributed_fused_adam(
             args.lr, weight_decay=args.weight_decay,
             axis_name=parallel_state.DATA_AXIS,
+            # found_inf must agree across TP ranks too: the probe sees
+            # only this rank's grad shards
+            probe_sync_axes=(parallel_state.TENSOR_AXIS,),
         )
         if args.dist_opt else None
     )
@@ -142,24 +161,35 @@ def main():
 
         def loss_fn(p):
             losses = model.apply(p, tokens, labels=labels)
-            return gpt_loss_fn(losses)
+            return gpt_loss_fn(losses) * scaler.loss_scale(sstate)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        scaled, grads = jax.value_and_grad(loss_fn)(params)
+        inv_scale = 1.0 / scaler.loss_scale(sstate)
         # NO grad pmean here: the optimizer's reduce-scatter over the
         # data axis IS the gradient averaging — that is the ZeRO
         # bargain (all-reduce bytes, but the Adam state the result
-        # feeds lives 1/dp-sharded)
-        updates, ostate2 = dist.update(grads, ostate, params)
+        # feeds lives 1/dp-sharded). The scaler composes through the
+        # optimizer: the inv_scale multiply + found_inf probe run as
+        # one fused pass over the PACKED grad buffers before the
+        # reduce-scatter (synced over data + tensor axes), and on
+        # overflow the kernel freezes masters/moments in place
+        updates, ostate2, info = dist.update(
+            grads, ostate, params, inv_scale=inv_scale, with_info=True
+        )
         params2 = optax.apply_updates(params, updates)
+        # host-visible scale bookkeeping (halve/grow/skip counters)
+        # unchanged from the non-dist path — the optimizer already
+        # applied the skip, so the returned flag only drives the scale
+        sstate2, _ = scaler.update(sstate, info["found_inf"])
+        loss = scaled * inv_scale
+        unscaled = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
         metrics = (
             Metrics.empty()
             .record("loss", loss)
-            .record_norm("grad_norm", grads)
-            .record_ratio_norms(grads, params, prefix="grad_ratio")
-            # schema parity with the scaler path: fp32 grads don't
-            # overflow, so scale pins at 1 and overflows at 0
-            .record("loss_scale", jnp.float32(1.0))
-            .record("overflows", jnp.float32(0.0))
+            .record_norm("grad_norm", unscaled)
+            .record_ratio_norms(unscaled, params, prefix="grad_ratio")
+            .record("loss_scale", sstate2.loss_scale)
+            .record("overflows", sstate2.overflows)
         )
         if args.flight_recorder is not None:
             metrics = metrics.merge(Metrics(group_nonfinite(
@@ -172,7 +202,7 @@ def main():
             lambda x: jax.lax.pmean(x, parallel_state.DATA_AXIS),
             metrics,
         )
-        return (params2, ostate2), sstate, metrics
+        return (params2, ostate2), sstate2, metrics
 
     def local_step(state, sstate, tokens, labels):
         def loss_fn(p):
@@ -196,11 +226,14 @@ def main():
         # a spike diagnostic rather than an exact global norm), plus
         # the scaler's own observability counters
         unscaled = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+        # packed states keep masters as flat buffers — the bf16 model
+        # tree is the per-leaf ratio-norm denominator there
+        denom = state.model if args.packed_update else state.master
         metrics = (
             Metrics.empty()
             .record("loss", loss)
             .record_norm("grad_norm", unscaled)
-            .record_ratio_norms(unscaled, state.master, prefix="grad_ratio")
+            .record_ratio_norms(unscaled, denom, prefix="grad_ratio")
             .record("loss_scale", sstate2.loss_scale)
             .record("overflows", sstate2.overflows)
         )

@@ -98,7 +98,9 @@ class LossScaler:
 
         Fuses the 1/scale multiply with the finite check, like the fused
         `multi_tensor_scale` unscale (reference: scaler.py:114-126).
-        Returns ``(unscaled_grads, found_inf)``.
+        Returns ``(unscaled_grads, found_inf)``. For grads already in
+        packed dtype-group buffers use `unscale_packed`, which folds the
+        probe into the same Pallas pass as the multiply.
         """
         inv = 1.0 / state.loss_scale
 
@@ -110,6 +112,24 @@ class LossScaler:
         unscaled = jax.tree_util.tree_map(_unscale, grads)
         found_inf = jnp.logical_not(all_finite(unscaled))
         return unscaled, found_inf
+
+    def unscale_packed(
+        self, state: ScalerState, packed_grads: Any
+    ) -> Tuple[Any, jnp.ndarray]:
+        """`unscale` over a `PackedTree` of grad buffers — exactly one
+        fused Pallas pass per dtype buffer, emitting the fp32 unscaled
+        buffer AND the inf/nan flag from the same read
+        (ops/multi_tensor.py `scale_packed`). Unlike the tree `unscale`,
+        there is no second `all_finite` reduction over the output: the
+        probe rides the multiply, one reduction per dtype buffer total
+        (the noop_flag contract of the fused multi_tensor_scale kernel,
+        reference: csrc/multi_tensor_scale_kernel.cu:30-136).
+        Returns ``(unscaled_packed_f32, found_inf)``.
+        """
+        from rocm_apex_tpu.ops.multi_tensor import scale_packed
+
+        inv = 1.0 / state.loss_scale
+        return scale_packed(packed_grads, inv, jnp.float32)
 
     def unscale_with_stashed(
         self, state: ScalerState, stashed: Any, grads: Any

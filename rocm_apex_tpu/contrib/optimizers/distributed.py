@@ -43,6 +43,18 @@ Both transformations must run where the data axis is bound (inside
 its FULL (unreduced) local grads — the reduce-scatter here replaces the
 DDP allreduce; do not pre-average.
 
+**Loss-scaler composition.** `update(..., inv_scale=1/loss_scale,
+with_info=True)` unscales the packed local grads — with the fused
+`isfinite` probe — in ONE pass per dtype buffer BEFORE the
+reduce-scatter (overflow-safe: the wire carries unscaled fp32), pmaxes
+the flag over the data axis plus `probe_sync_axes` so every rank takes
+the same skip decision, folds a found_inf-predicated no-op into the
+update kernels (masters/moments/count freeze, deltas exactly zero),
+and returns the flag in the info dict for the host-side
+`LossScaler.update` scale/skip logic — which stays unchanged
+(amp/scaler.py). This is the reference's `_step_supports_amp_scaling`
+contract on sharded state (distributed_fused_adam.py:254-321).
+
 The returned updates are master-driven deltas: applying them with
 `optax.apply_updates` makes the model params equal the WIRE-dtype cast
 of the fp32 masters (to one fp32 ulp — the delta application re-rounds
@@ -191,6 +203,25 @@ def _wd_shards(spec, weight_decay, mask, dims, rank):
     return out
 
 
+def _unscale_probe(pg, inv_scale, axis_name, probe_sync_axes):
+    """Fused unscale + found_inf over the FULL local packed grads.
+
+    Runs before the reduce-scatter so the wire carries unscaled fp32
+    (the reference unscales pre-reduction too when overflow-safe,
+    distributed_fused_adam.py:254-321). The flag is pmaxed over the
+    data axis AND any `probe_sync_axes` (e.g. the tensor axis) so the
+    kernel-level skip decision is identical on every rank — a re-sync
+    in the caller's scaler (`GradScaler.update`) is then idempotent.
+    """
+    from rocm_apex_tpu.ops.multi_tensor import scale_packed
+
+    pg, local_inf = scale_packed(pg, inv_scale, jnp.float32)
+    flag = local_inf.astype(jnp.int32)
+    for ax in (axis_name,) + tuple(probe_sync_axes):
+        flag = jax.lax.pmax(flag, ax)
+    return pg, flag > 0
+
+
 def _global_grad_sumsq(grad_shards, axis_name):
     """Shards are disjoint after the reduce-scatter, so the global grad
     L2 norm is the psum of per-shard row-sumsq totals (the analogue of
@@ -216,6 +247,7 @@ def distributed_fused_adam(
     predivide: bool = True,
     allgather_dtype: str = "fp32",
     axis_name: str = parallel_state.DATA_AXIS,
+    probe_sync_axes: Tuple[str, ...] = (),
 ) -> optax.GradientTransformation:
     """ZeRO-sharded fused Adam over `axis_name`.
 
@@ -223,6 +255,9 @@ def distributed_fused_adam(
     (reference: apex/contrib/optimizers/distributed_fused_adam.py:55-127);
     `max_grad_norm > 0` enables the fused global-norm clip
     (`clip_grad_norm=True` there). Must run with `axis_name` bound.
+    `update(..., inv_scale=, with_info=True)` composes the amp loss
+    scaler (module header); `probe_sync_axes` lists extra bound mesh
+    axes (e.g. the tensor axis) the found_inf flag syncs over.
     """
     beta1, beta2 = betas
     wire = _wire_dtype(allgather_dtype)
@@ -241,15 +276,22 @@ def distributed_fused_adam(
             v=zeros,
         )
 
-    def update_fn(grads, state, params=None):
+    def update_fn(grads, state, params=None, *, inv_scale=None,
+                  with_info=False):
         if params is None:
             raise ValueError("distributed_fused_adam requires params in update()")
         spec, pp, pg = c.pack_params_and_grads(params, grads)
         world, rank, dims = _shard_meta(spec, axis_name)
 
-        count = state.count + 1
-        lr = c.resolve_lr(learning_rate, count)
-        t = count.astype(jnp.float32)
+        found_inf = None
+        if inv_scale is not None:
+            pg, found_inf = _unscale_probe(
+                pg, inv_scale, axis_name, probe_sync_axes
+            )
+
+        count_live = state.count + 1
+        lr = c.resolve_lr(learning_rate, count_live)
+        t = count_live.astype(jnp.float32)
         if bias_correction:
             bc1 = 1.0 - beta1**t
             bc2 = 1.0 - beta2**t
@@ -266,26 +308,43 @@ def distributed_fused_adam(
 
         wd_shards = _wd_shards(spec, weight_decay, weight_decay_mask, dims, rank)
 
+        scalars = [lr, beta1, 1.0 - beta1, beta2, 1.0 - beta2, eps, bc1,
+                   bc2, gs]
+        if found_inf is not None:
+            # kernel-level skip: deltas exactly zero, moments frozen
+            scalars = scalars + [found_inf.astype(jnp.float32)]
+
         new_master, new_m, new_v = [], [], []
         for mast, gsh, mbuf, vbuf, wd in zip(
             state.master, g_shards, state.m, state.v, wd_shards
         ):
             d, m2, v2 = optim_kernels.adam_update(
-                mast, gsh, mbuf, vbuf, wd,
-                [lr, beta1, beta2, eps, bc1, bc2, gs],
-                adam_w_mode,
+                mast, gsh, mbuf, vbuf, wd, scalars, adam_w_mode,
             )
             new_master.append(mast + d)
             new_m.append(m2)
             new_v.append(v2)
 
+        if found_inf is None:
+            count = count_live
+        else:
+            count = state.count + jnp.logical_not(found_inf).astype(jnp.int32)
+
         updates = _emit_updates(spec, pp, new_master, dims, axis_name, wire)
-        return updates, DistributedAdamState(
+        new_state = DistributedAdamState(
             count=count,
             master=tuple(new_master),
             m=tuple(new_m),
             v=tuple(new_v),
         )
+        if with_info:
+            info = {
+                "found_inf": (
+                    jnp.asarray(False) if found_inf is None else found_inf
+                )
+            }
+            return updates, new_state, info
+        return updates, new_state
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -306,6 +365,7 @@ def distributed_fused_lamb(
     predivide: bool = True,
     allgather_dtype: str = "fp32",
     axis_name: str = parallel_state.DATA_AXIS,
+    probe_sync_axes: Tuple[str, ...] = (),
 ) -> optax.GradientTransformation:
     """ZeRO-sharded fused LAMB over `axis_name`.
 
@@ -333,15 +393,22 @@ def distributed_fused_lamb(
             v=zeros,
         )
 
-    def update_fn(grads, state, params=None):
+    def update_fn(grads, state, params=None, *, inv_scale=None,
+                  with_info=False):
         if params is None:
             raise ValueError("distributed_fused_lamb requires params in update()")
         spec, pp, pg = c.pack_params_and_grads(params, grads)
         world, rank, dims = _shard_meta(spec, axis_name)
 
-        count = state.count + 1
-        lr = c.resolve_lr(learning_rate, count)
-        t = count.astype(jnp.float32)
+        found_inf = None
+        if inv_scale is not None:
+            pg, found_inf = _unscale_probe(
+                pg, inv_scale, axis_name, probe_sync_axes
+            )
+
+        count_live = state.count + 1
+        lr = c.resolve_lr(learning_rate, count_live)
+        t = count_live.astype(jnp.float32)
         if bias_correction:
             bc1 = 1.0 - beta1**t
             bc2 = 1.0 - beta2**t
@@ -368,7 +435,7 @@ def distributed_fused_lamb(
         ):
             u, m2, v2 = optim_kernels.lamb_stage1(
                 mast, gsh, mbuf, vbuf, wd,
-                [beta1, beta2, beta3, eps, bc1, bc2, gs, clip],
+                [beta1, beta2, 1.0 - beta2, beta3, eps, bc1, bc2, gs, clip],
                 adam_w_mode,
             )
             # sharded per-tensor norms: local segmented partials + psum
@@ -402,17 +469,37 @@ def distributed_fused_lamb(
             padded = jnp.concatenate([ratio, jnp.ones((1,), ratio.dtype)])
             ratio_col = padded[ids_shard][:, None]
             (d,) = optim_kernels.lamb_stage2(u, ratio_col, [lr])
+            if found_inf is not None:
+                # buffer-level freeze (stage1 has no skip slot): deltas
+                # exactly zero so `mast + d` is bitwise-unchanged
+                ok = jnp.logical_not(found_inf)
+                d = jnp.where(ok, d, 0.0)
+                m2 = jnp.where(ok, m2, mbuf)
+                v2 = jnp.where(ok, v2, vbuf)
             new_master.append(mast + d)
             new_m.append(m2)
             new_v.append(v2)
 
+        if found_inf is None:
+            count = count_live
+        else:
+            count = state.count + jnp.logical_not(found_inf).astype(jnp.int32)
+
         updates = _emit_updates(spec, pp, new_master, dims, axis_name, wire)
-        return updates, DistributedLAMBState(
+        new_state = DistributedLAMBState(
             count=count,
             master=tuple(new_master),
             m=tuple(new_m),
             v=tuple(new_v),
         )
+        if with_info:
+            info = {
+                "found_inf": (
+                    jnp.asarray(False) if found_inf is None else found_inf
+                )
+            }
+            return updates, new_state, info
+        return updates, new_state
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -435,6 +522,7 @@ class DistributedFusedAdam(c.FusedOptimizer):
         allgather_dtype: str = "fp32",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
+        probe_sync_axes: Tuple[str, ...] = (),
     ):
         if amsgrad:
             raise RuntimeError(
@@ -453,6 +541,7 @@ class DistributedFusedAdam(c.FusedOptimizer):
                 predivide=predivide,
                 allgather_dtype=allgather_dtype,
                 axis_name=axis_name,
+                probe_sync_axes=probe_sync_axes,
             )
         )
 
@@ -476,6 +565,7 @@ class DistributedFusedLAMB(c.FusedOptimizer):
         allgather_dtype: str = "fp32",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
+        probe_sync_axes: Tuple[str, ...] = (),
     ):
         if amsgrad:
             raise RuntimeError(
@@ -496,5 +586,6 @@ class DistributedFusedLAMB(c.FusedOptimizer):
                 allgather_dtype=allgather_dtype,
                 weight_decay_mask=weight_decay_mask,
                 axis_name=axis_name,
+                probe_sync_axes=probe_sync_axes,
             )
         )

@@ -37,6 +37,13 @@ clever):
   shape anywhere in the program — inputs and constants are NOT
   intermediates, so a probe for a forbidden materialization cannot be
   fooled by the operand that legitimately enters at a region boundary.
+* **eqn_count** is the total number of primitive equations the program
+  executes (trip-count multiplied like ``counts``; cond branches merge
+  by MAX; a container equation counts itself plus its body). This is
+  the fusion-granularity regression metric (arXiv 2301.13062): a
+  tree_map'd optimizer update emits O(num_leaves) equations while the
+  packed-buffer path emits O(dtype_groups) — asserting the count pins
+  the program SHAPE, where wall-clock only samples it.
 """
 
 import dataclasses
@@ -78,6 +85,7 @@ class AuditReport:
     dot_flops: float
     dot_count: float
     shapes: FrozenSet[Tuple[int, ...]]
+    eqn_count: float = 0.0
     while_lower_bound: bool = False
 
     # -- accessors ------------------------------------------------------
@@ -127,6 +135,7 @@ class AuditReport:
             + (" (while-loop: lower bounds)" if self.while_lower_bound
                else "")
         )
+        lines.append(f"equations: {int(self.eqn_count)}")
         return "\n".join(lines)
 
 
@@ -167,11 +176,13 @@ def _walk(jaxpr) -> AuditReport:
     nbytes: Dict[str, float] = {}
     dot_flops = 0.0
     dot_count = 0.0
+    eqns_total = 0.0
     shapes = set()
     lower_bound = False
 
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
+        eqns_total += 1.0  # the equation itself (containers add bodies below)
         for ov in eqn.outvars:
             aval = getattr(ov, "aval", None)
             if aval is not None and getattr(aval, "shape", None) is not None:
@@ -201,19 +212,21 @@ def _walk(jaxpr) -> AuditReport:
             # one branch executes: merge branch audits by max
             b_counts: Dict[str, float] = {}
             b_bytes: Dict[str, float] = {}
-            b_flops = b_dots = 0.0
+            b_flops = b_dots = b_eqns = 0.0
             for br in inner:
                 r = _walk(br)
                 _merge_max(b_counts, r.counts)
                 _merge_max(b_bytes, r.bytes_moved)
                 b_flops = max(b_flops, r.dot_flops)
                 b_dots = max(b_dots, r.dot_count)
+                b_eqns = max(b_eqns, r.eqn_count)
                 shapes |= r.shapes
                 lower_bound |= r.while_lower_bound
             _merge(counts, b_counts, 1.0)
             _merge(nbytes, b_bytes, 1.0)
             dot_flops += b_flops
             dot_count += b_dots
+            eqns_total += b_eqns
             continue
         scale = 1.0
         if name == "scan":
@@ -227,6 +240,7 @@ def _walk(jaxpr) -> AuditReport:
             _merge(nbytes, r.bytes_moved, scale)
             dot_flops += r.dot_flops * scale
             dot_count += r.dot_count * scale
+            eqns_total += r.eqn_count * scale
             shapes |= r.shapes
             lower_bound |= r.while_lower_bound
 
@@ -236,6 +250,7 @@ def _walk(jaxpr) -> AuditReport:
         dot_flops=dot_flops,
         dot_count=dot_count,
         shapes=frozenset(shapes),
+        eqn_count=eqns_total,
         while_lower_bound=lower_bound,
     )
 

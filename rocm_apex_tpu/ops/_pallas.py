@@ -22,6 +22,8 @@ __all__ = [
     "row_block",
     "pad_rows",
     "kernel_dtype",
+    "DirectRef",
+    "DirectOutRef",
 ]
 
 # One packed "row" is a full fp32 VREG tile row: 8 sublanes x 128 lanes.
@@ -39,6 +41,40 @@ def pallas_call(kernel, **kwargs):
     if not on_tpu():
         kwargs.setdefault("interpret", True)
     return pl.pallas_call(kernel, **kwargs)
+
+
+class DirectRef:
+    """Whole-buffer stand-in for a pallas input Ref.
+
+    Off-TPU, kernels whose body is pure elementwise / (rows,1)-broadcast
+    / row-reduction math can run ONCE over the full buffer instead of
+    per grid block under the interpreter — same values (the grid is a
+    row partition and no op crosses rows), none of the interpreter's
+    per-block dynamic-slice traffic. Supports the two read idioms the
+    packed-optimizer kernels use: ``ref[...]`` and ``ref[0, i]``.
+    """
+
+    def __init__(self, arr):
+        self._arr = arr
+        self.dtype = arr.dtype
+
+    def __getitem__(self, idx):
+        if idx is Ellipsis:
+            return self._arr
+        return self._arr[idx]
+
+
+class DirectOutRef:
+    """Output Ref stand-in for the direct path: collects the single
+    full-buffer write (``ref[...] = v``) and exposes ``dtype`` for the
+    kernels that cast into their output."""
+
+    def __init__(self, dtype):
+        self.dtype = jnp.dtype(dtype)
+        self.value = None
+
+    def __setitem__(self, idx, val):
+        self.value = jnp.asarray(val).astype(self.dtype)
 
 
 def row_block(width: int, itemsize: int = 4, cap: int = 256) -> int:

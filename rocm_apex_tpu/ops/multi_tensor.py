@@ -21,7 +21,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from rocm_apex_tpu.ops._pallas import kernel_dtype, pallas_call
+from rocm_apex_tpu.ops._pallas import (
+    DirectOutRef,
+    DirectRef,
+    kernel_dtype,
+    on_tpu,
+    pallas_call,
+)
 from rocm_apex_tpu.ops.packing import (
     WIDTH,
     PackedTree,
@@ -34,6 +40,7 @@ from rocm_apex_tpu.ops.packing import (
 __all__ = [
     "scale_packed",
     "scale",
+    "scale_sumsq_packed",
     "axpby_packed",
     "axpby",
     "l2norm_packed",
@@ -79,6 +86,13 @@ def _scale_buffer(buf, s, out_dtype):
     grid = _grid(rows)
     buf = buf.astype(kernel_dtype(buf.dtype))
     kd_out = kernel_dtype(out_dtype)
+    if not on_tpu():
+        # direct whole-buffer run of the same kernel body (the grid is
+        # a row partition; see DirectRef) — skips the interpreter's
+        # per-block slicing on the CPU harness
+        o, f = DirectOutRef(kd_out), DirectOutRef(jnp.int32)
+        _scale_kernel(DirectRef(buf), DirectRef(s), o, f)
+        return o.value.astype(out_dtype), f.value > 0
     out, flags = pallas_call(
         _scale_kernel,
         grid=(grid,),
@@ -121,6 +135,79 @@ def scale(tree: Any, scale_val, out_dtype=None) -> Tuple[Any, jnp.ndarray]:
 
 
 # ---------------------------------------------------------------------------
+# scale + sumsq: out = in * scale, fused non-finite probe AND per-row sum of
+# squares of the scaled values — the unscale/probe/grad-norm phase of the
+# packed optimizer step in ONE read of each buffer.
+# ---------------------------------------------------------------------------
+
+
+def _scale_sumsq_kernel(x_ref, s_ref, out_ref, flag_ref, rsq_ref):
+    x = x_ref[...].astype(jnp.float32) * s_ref[0, 0]
+    flag_ref[0, 0] = jnp.logical_not(jnp.isfinite(x).all()).astype(jnp.int32)
+    out_ref[...] = x.astype(out_ref.dtype)
+    rsq_ref[...] = jnp.sum(x * x, axis=1, keepdims=True)
+
+
+def _scale_sumsq_buffer(buf, s, out_dtype):
+    rows = buf.shape[0]
+    grid = _grid(rows)
+    buf = buf.astype(kernel_dtype(buf.dtype))
+    kd_out = kernel_dtype(out_dtype)
+    if not on_tpu():
+        o = DirectOutRef(kd_out)
+        f = DirectOutRef(jnp.int32)
+        r = DirectOutRef(jnp.float32)
+        _scale_sumsq_kernel(DirectRef(buf), DirectRef(s), o, f, r)
+        return o.value.astype(out_dtype), f.value > 0, r.value
+    out, flags, rsq = pallas_call(
+        _scale_sumsq_kernel,
+        grid=(grid,),
+        in_specs=[_vmem_spec(), _smem_scalar_spec()],
+        out_specs=[
+            _vmem_spec(),
+            _flag_out_spec(),
+            pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, WIDTH), kd_out),
+            jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+    )(buf, s)
+    return out.astype(out_dtype), flags.sum() > 0, rsq
+
+
+def scale_sumsq_packed(
+    packed: PackedTree, scale_val, out_dtype=None
+) -> Tuple[PackedTree, jnp.ndarray, Tuple[jnp.ndarray, ...]]:
+    """out = packed * scale; returns (out, found_inf, per_group_row_sumsq).
+
+    The scaler-unscale half-step of the packed optimizer
+    (reference: multi_tensor_scale + multi_tensor_l2norm back to back,
+    csrc/multi_tensor_scale_kernel.cu + csrc/multi_tensor_l2norm_kernel.cu)
+    collapsed into a single pass: each dtype buffer is read once and
+    yields the unscaled values, the non-finite flag, AND the (rows, 1)
+    partial sums of squares the global-grad-norm clip consumes. The
+    row-aligned layout keeps the row sums segmentable into per-tensor
+    norms downstream (`l2norm_packed`).
+    """
+    s = jnp.asarray(scale_val, jnp.float32).reshape(1, 1)
+    outs, infs, rsqs = [], [], []
+    for buf, g in zip(packed.buffers, packed.spec.groups):
+        od = jnp.dtype(out_dtype).name if out_dtype is not None else g.dtype
+        out, inf, rsq = _scale_sumsq_buffer(buf, s, od)
+        outs.append(out)
+        infs.append(inf)
+        rsqs.append(rsq)
+    found_inf = jnp.stack(infs).any() if infs else jnp.asarray(False)
+    return (
+        PackedTree(outs, respec(packed.spec, out_dtype)),
+        found_inf,
+        tuple(rsqs),
+    )
+
+
+# ---------------------------------------------------------------------------
 # axpby: out = a*x + b*y, fused non-finite probe
 # ---------------------------------------------------------------------------
 
@@ -157,6 +244,15 @@ def axpby_packed(
         xb = xb.astype(kernel_dtype(xb.dtype))
         yb = yb.astype(kernel_dtype(yb.dtype))
         kd_out = kernel_dtype(od)
+        if not on_tpu():
+            o, f = DirectOutRef(kd_out), DirectOutRef(jnp.int32)
+            _axpby_kernel(
+                DirectRef(xb), DirectRef(yb), DirectRef(a), DirectRef(b),
+                o, f,
+            )
+            outs.append(o.value.astype(od))
+            infs.append(f.value > 0)
+            continue
         out, flags = pallas_call(
             _axpby_kernel,
             grid=(grid,),
@@ -200,6 +296,10 @@ def row_sumsq(buf) -> jnp.ndarray:
     rows = buf.shape[0]
     grid = _grid(rows)
     buf = buf.astype(kernel_dtype(buf.dtype))
+    if not on_tpu():
+        o = DirectOutRef(jnp.float32)
+        _rowsum_sq_kernel(DirectRef(buf), o)
+        return o.value
     return pallas_call(
         _rowsum_sq_kernel,
         grid=(grid,),

@@ -22,6 +22,14 @@ from rocm_apex_tpu.optimizers.fused_novograd import (
     fused_novograd,
 )
 from rocm_apex_tpu.optimizers.fused_sgd import FusedSGD, FusedSGDState, fused_sgd
+from rocm_apex_tpu.optimizers.packed import (
+    PackedAdamState,
+    PackedLAMBState,
+    PackedOptimizerStep,
+    PackedStepState,
+    packed_adam,
+    packed_lamb,
+)
 
 __all__ = [
     "FusedAdam",
@@ -40,4 +48,10 @@ __all__ = [
     "FusedSGD",
     "FusedSGDState",
     "fused_sgd",
+    "PackedAdamState",
+    "PackedLAMBState",
+    "PackedOptimizerStep",
+    "PackedStepState",
+    "packed_adam",
+    "packed_lamb",
 ]

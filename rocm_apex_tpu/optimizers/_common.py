@@ -198,6 +198,12 @@ class FusedOptimizer:
         return self.tx.init(params)
 
     def step(self, params, grads, state, *, skip=None):
+        if skip is not None and getattr(self.tx.update, "kernel_skip", False):
+            # packed transforms fold the skip into the update kernel's
+            # buffer writes (deltas exactly zero, moments/count frozen)
+            # — no O(leaves) tree_where select pass afterwards
+            updates, new_state = self.tx.update(grads, state, params, skip=skip)
+            return optax.apply_updates(params, updates), new_state
         updates, new_state = self.tx.update(grads, state, params)
         new_params = optax.apply_updates(params, updates)
         if skip is None:

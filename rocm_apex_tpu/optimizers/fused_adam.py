@@ -6,17 +6,21 @@ fp32 math, `adam_w_mode` switching between L2 and decoupled decay,
 optional bias correction, and bf16/fp16 param support (reference
 fused_adam.py:134-145 — the ROCm fork's bf16 path is primary here).
 
-**Why tree-fused math, not the packed Pallas kernels.** The CUDA
-reference packs tensor lists into flat buffers because a kernel launch
-per tensor dominates there (csrc/multi_tensor_apply.cuh). On TPU the
-measured reality is the opposite: (8,128)-tiled arrays do not linearize
-for free, so packing params+grads every step is a ~20 ms/step physical
-relayout on a 134M-param model (optimizers/mixed.py header has the
-numbers), while XLA fuses the whole per-leaf update into a handful of
-bandwidth-bound fusions with zero packing traffic. The packed Pallas
-kernels (ops/optim_kernels.py) remain the substrate where packed layout
-is structurally required — the row-sharded ZeRO optimizers
-(contrib/optimizers/distributed.py).
+**Why tree-fused math by default, not the packed Pallas kernels.** The
+CUDA reference packs tensor lists into flat buffers because a kernel
+launch per tensor dominates there (csrc/multi_tensor_apply.cuh). On TPU
+the measured reality is the opposite: (8,128)-tiled arrays do not
+linearize for free, so packing params+grads every step is a ~20 ms/step
+physical relayout on a 134M-param model (optimizers/mixed.py header has
+the numbers), while XLA fuses the whole per-leaf update into a handful
+of bandwidth-bound fusions with zero packing traffic. `packed=True`
+opts into the multi_tensor_apply pipeline (optimizers/packed.py): the
+update phase becomes O(dtype-groups) traced equations instead of
+O(leaves), moments live packed, and overflow skipping folds into the
+kernel — the right trade when fusion granularity, audit-stable program
+shape, or shardability dominate (the row-sharded ZeRO optimizers in
+contrib/optimizers/distributed.py always run packed). docs/perf.md
+§"The optimizer step" quantifies when each side wins.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -46,6 +50,7 @@ def fused_adam(
     weight_decay: float = 0.0,
     weight_decay_mask: Optional[Any] = None,
     grad_scale: Optional[Any] = None,
+    packed: bool = False,
 ) -> optax.GradientTransformation:
     """Build the fused Adam gradient transformation.
 
@@ -54,8 +59,24 @@ def fused_adam(
     is AdamW (decoupled decay), False folds decay into the gradient.
     `grad_scale` (1/loss_scale) fuses gradient unscaling into the update
     pass. `weight_decay_mask` replaces torch param groups for
-    decay-exempting biases/norm params.
+    decay-exempting biases/norm params. `packed=True` runs the same
+    math over flat dtype-group buffers (optimizers/packed.py): same
+    updates bit-for-bit on fp32, O(dtype-groups) traced equations, and
+    a kernel-level found_inf no-op on overflow.
     """
+    if packed:
+        from rocm_apex_tpu.optimizers.packed import packed_adam
+
+        return packed_adam(
+            learning_rate,
+            bias_correction=bias_correction,
+            betas=betas,
+            eps=eps,
+            adam_w_mode=adam_w_mode,
+            weight_decay=weight_decay,
+            weight_decay_mask=weight_decay_mask,
+            grad_scale=grad_scale,
+        )
     beta1, beta2 = betas
 
     def init_fn(params):

@@ -40,8 +40,32 @@ def fused_lamb(
     use_nvlamb: bool = False,
     weight_decay_mask: Optional[Any] = None,
     grad_scale: Optional[Any] = None,
+    packed: bool = False,
 ) -> optax.GradientTransformation:
-    """Build the fused LAMB transformation (reference fused_lamb.py:24-87)."""
+    """Build the fused LAMB transformation (reference fused_lamb.py:24-87).
+
+    `packed=True` runs the pipeline over flat dtype-group buffers
+    (optimizers/packed.py): the global grad norm comes from the same
+    fused pass that unscales and probes the grads, trust ratios from
+    segmented row reductions — O(dtype-groups) traced equations, parity
+    with this path to a documented reduction-order tolerance.
+    """
+    if packed:
+        from rocm_apex_tpu.optimizers.packed import packed_lamb
+
+        return packed_lamb(
+            learning_rate,
+            bias_correction=bias_correction,
+            betas=betas,
+            eps=eps,
+            weight_decay=weight_decay,
+            grad_averaging=grad_averaging,
+            adam_w_mode=adam_w_mode,
+            max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb,
+            weight_decay_mask=weight_decay_mask,
+            grad_scale=grad_scale,
+        )
     beta1, beta2 = betas
     beta3 = 1.0 - beta1 if grad_averaging else 1.0
 

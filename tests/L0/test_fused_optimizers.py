@@ -365,8 +365,8 @@ class TestAmpIntegration:
 
 
 class TestAdamKernelSkipFlag:
-    def test_eighth_scalar_freezes_buffers(self):
-        """The in-kernel skip flag (8th scalar) must zero the delta and
+    def test_skip_scalar_freezes_buffers(self):
+        """The in-kernel skip flag (10th scalar) must zero the delta and
         pass moments through even when grads are inf (inf*0 trap)."""
         import jax
         import jax.numpy as jnp
@@ -380,7 +380,8 @@ class TestAdamKernelSkipFlag:
         m = jnp.ones((rows, WIDTH)) * 0.5
         v = jnp.ones((rows, WIDTH)) * 0.25
         wd = jnp.zeros((rows, 1))
-        scalars = [1e-2, 0.9, 0.999, 1e-8, 0.1, 0.001, 1.0, 1.0]  # skip=1
+        # [lr, b1, 1-b1, b2, 1-b2, eps, bc1, bc2, gs, skip=1]
+        scalars = [1e-2, 0.9, 0.1, 0.999, 0.001, 1e-8, 0.1, 0.001, 1.0, 1.0]
         d, m2, v2 = optim_kernels.adam_update(p, g, m, v, wd, scalars, True)
         np.testing.assert_array_equal(np.asarray(d), 0.0)
         np.testing.assert_array_equal(np.asarray(m2), np.asarray(m))

@@ -70,6 +70,17 @@ CASES = [
          "--tensor-model-parallel-size", "2", "--dist-opt"],
     ),
     (
+        "gpt_train.py --packed-update",
+        ["--num-layers", "2", "--hidden-size", "64",
+         "--num-attention-heads", "4", "--seq-length", "32",
+         "--max-position-embeddings", "32", "--micro-batch-size", "2",
+         "--train-iters", "2", "--log-interval", "1",
+         # packed path: the whole update phase (unscale + found_inf +
+         # Adam) runs as one pass per dtype buffer via
+         # PackedOptimizerStep instead of MixedPrecisionAdam
+         "--packed-update"],
+    ),
+    (
         "generate_gpt.py --spec-k",
         ["--num-layers", "2", "--hidden-size", "64",
          "--num-attention-heads", "4", "--max-seq-len", "64",

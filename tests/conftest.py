@@ -98,6 +98,28 @@ _DURATIONS_PATH = os.environ.get(
 )
 _durations = {}
 
+# Persistent-compile-cache observability: the budget above assumes the
+# cache works. Count the backend's own cache events so every durations
+# dump says how much of the run actually compiled — a silently cold
+# cache (cleared /tmp, bumped jax, changed XLA flags) shows up as
+# hit_ratio 0 in tools/check_tier1_budget.py instead of as a mystery
+# wall-time regression.
+_compile_cache = {"requests": 0, "hits": 0, "misses": 0}
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/compile_requests_use_cache": "requests",
+    "/jax/compilation_cache/cache_hits": "hits",
+    "/jax/compilation_cache/cache_misses": "misses",
+}
+
+
+def _cache_event_listener(event, **kwargs):
+    key = _CACHE_EVENTS.get(event)
+    if key is not None:
+        _compile_cache[key] += 1
+
+
+jax.monitoring.register_event_listener(_cache_event_listener)
+
 
 def pytest_runtest_logreport(report):
     _durations[report.nodeid] = (
@@ -113,6 +135,16 @@ def pytest_sessionfinish(session, exitstatus):
             json.dump(
                 {
                     "total_seconds": round(sum(_durations.values()), 3),
+                    "compile_cache": {
+                        "requests": _compile_cache["requests"],
+                        "hits": _compile_cache["hits"],
+                        "misses": _compile_cache["misses"],
+                        "hit_ratio": round(
+                            _compile_cache["hits"]
+                            / max(1, _compile_cache["requests"]),
+                            3,
+                        ),
+                    },
                     "durations": {
                         k: round(v, 3) for k, v in _durations.items()
                     },

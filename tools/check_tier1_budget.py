@@ -59,6 +59,13 @@ def main() -> int:
               "(tests/conftest.py writes it)", file=sys.stderr)
         return 2
     durations = dump["durations"]
+    # older dumps predate the compile-cache counters — stay readable
+    cache = dump.get("compile_cache")
+    if cache:
+        print(f"compile cache: {cache.get('hits', 0)}/"
+              f"{cache.get('requests', 0)} requests hit "
+              f"(ratio {cache.get('hit_ratio', 0.0):.3f}, "
+              f"{cache.get('misses', 0)} cold compiles)")
 
     if args.update:
         with open(args.budget, "w") as f:
