@@ -28,7 +28,17 @@ series stays separate from the dp bench above.
 
 `--audit` (gpt bench) additionally prints a static program audit of
 one train step to stderr — collective counts/bytes + dot FLOPs from
-`rocm_apex_tpu.monitor.audit` (trace-only, no timing impact).
+`rocm_apex_tpu.monitor.audit` (trace-only, no timing impact) — and
+emits the estimated per-step collective wire bytes as a
+`gpt_comm_payload_mib` jsonl metric.
+
+`--comm-dtype=int8` (gpt bench) quantizes the ring-collective hop
+payloads to int8 with fp32 scale sidecars
+(ops/quantized_collectives.py): with `--dist-opt` the ZeRO grad
+reduce-scatter and param all-gather rings, with `--collective-matmul`
+the TP-boundary rings. The `--dist-opt` bench always emits
+`gpt_comm_payload_mib` (audit-traced, ~3.5-4x lower at int8) next to
+the step-time line; docs/perf.md has the A/B numbers.
 
 `python bench.py serve` measures the SERVING path: the continuous-
 batching engine's chunked-prefill token-budget scheduler on a mixed
@@ -1080,11 +1090,21 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
          remat: bool = False, loss: str = "fused",
          seq_parallel: bool = False, collective_matmul: bool = False,
          audit: bool = False, dist_opt: bool = False,
-         packed_update: bool = False):
+         packed_update: bool = False, comm_dtype: str = "fp32"):
     if loss not in ("fused", "naive"):
         raise SystemExit(f"--loss must be 'fused' or 'naive', got {loss!r}")
     if collective_matmul and not seq_parallel:
         raise SystemExit("--collective-matmul requires --seq-parallel")
+    if comm_dtype not in ("fp32", "int8"):
+        raise SystemExit(
+            f"--comm-dtype must be 'fp32' or 'int8', got {comm_dtype!r}"
+        )
+    if comm_dtype != "fp32" and not (dist_opt or collective_matmul):
+        raise SystemExit(
+            "--comm-dtype=int8 quantizes ring collectives; it needs "
+            "--dist-opt (ZeRO grad/param rings) or --collective-matmul "
+            "(TP-boundary rings) to have a ring to quantize"
+        )
     if dist_opt and seq_parallel:
         raise SystemExit(
             "--dist-opt does not compose with --seq-parallel"
@@ -1129,6 +1149,7 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         tensor_parallel_size=tp,
         sequence_parallel=seq_parallel,
         collective_matmul=collective_matmul,
+        comm_dtype=comm_dtype if collective_matmul else "fp32",
         checkpoint_activations=remat,
     )
     seq = min(seq, cfg.max_position_embeddings)
@@ -1190,7 +1211,7 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         dmesh = Mesh(np.array(jax.devices()), ("data",))
         dist = distributed_fused_adam(
             1e-4, weight_decay=0.01, allgather_dtype="fp32",
-            axis_name="data",
+            axis_name="data", comm_dtype=comm_dtype,
         )
         ostate = jax.jit(
             shard_map(
@@ -1273,6 +1294,8 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         if remat:
             suffix += "_remat"
         suffix += f"_zero_dp{dp}"
+        if comm_dtype != "fp32":
+            suffix += f"_{comm_dtype}comm"
         _report(
             f"gpt_train_tokens_per_sec_per_chip{suffix}",
             batch * seq / dt / dp, "tokens/s", mfu / 0.70,
@@ -1283,6 +1306,44 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
             f"b={batch} s={seq} remat={remat} "
             f"backend={jax.default_backend()}",
         )
+        # static comm audit (monitor/audit.py): trace ONE ZeRO step
+        # abstractly — no compile, no timing impact — and land the
+        # estimated collective wire bytes in the jsonl BENCH output so
+        # the --comm-dtype A/B is a first-class metric, not a stderr
+        # footnote.
+        def _one_zero(params, ostate, rng, tok_l, lab_l):
+            rng, step_rng = jax.random.split(rng)
+
+            def loss_fn(p):
+                rngs = {"dropout": step_rng} if dropout > 0.0 else None
+                return model.apply(
+                    p, tok_l, labels=lab_l, loss_reduction="mean",
+                    deterministic=dropout == 0.0, rngs=rngs,
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, _ = dist.update(grads, ostate, params)
+            return loss
+
+        rep = monitor.audit(
+            shard_map(
+                _one_zero, mesh=dmesh,
+                in_specs=(P(), P(), P(), P("data"), P("data")),
+                out_specs=P(), check_rep=False,
+            ),
+            params_z, ostate, rng0, tokens, labels,
+        )
+        comm_mib = rep.collective_wire_bytes * mb
+        _report(
+            f"gpt_comm_payload_mib{suffix}", comm_mib, "MiB", 1.0,
+            f"estimated per-step collective wire bytes (ZeRO dp={dp}, "
+            f"comm_dtype={comm_dtype}; monitor/audit.py conventions) "
+            f"ppermute={rep.count('ppermute')} "
+            f"backend={jax.default_backend()}",
+        )
+        if audit:
+            print("audit: one gpt ZeRO train step", file=sys.stderr)
+            print(rep.summary(), file=sys.stderr)
         return
 
     state = opt.init(params32)
@@ -1448,6 +1509,8 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         # sequence-parallel collectives) vs _spcm (ring collective
         # matmuls), never mixed with the dp series above
         suffix += ("_spcm" if collective_matmul else "_sp") + f"_tp{tp}"
+    if comm_dtype != "fp32":
+        suffix += f"_{comm_dtype}comm"
 
     # head share: fwd+bwd of the fused LM head + CE alone, on a bench-
     # shaped hidden batch against the real tied table — the number the
@@ -1508,6 +1571,17 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
             else ""
         ),
     )
+    if audit:
+        # the same traced report that printed to stderr, landed in the
+        # jsonl output: estimated per-step collective wire bytes
+        _report(
+            f"gpt_comm_payload_mib{suffix}",
+            report.collective_wire_bytes / (1024 * 1024), "MiB", 1.0,
+            f"estimated per-step collective wire bytes "
+            f"(comm_dtype={comm_dtype}; monitor/audit.py conventions) "
+            f"ppermute={report.count('ppermute')} "
+            f"backend={jax.default_backend()}",
+        )
 
     if packed_update:
         # ---- packed-buffer optimizer A/B (--packed-update): rerun the
@@ -1668,6 +1742,8 @@ if __name__ == "__main__":
             kwargs["spec_k"] = int(a.split("=", 1)[1])
         elif a == "--dist-opt":
             kwargs["dist_opt"] = True
+        elif a.startswith("--comm-dtype="):
+            kwargs["comm_dtype"] = a.split("=", 1)[1]
         elif a == "--packed-update":
             kwargs["packed_update"] = True
         elif a.startswith("--fused="):
@@ -1713,6 +1789,8 @@ if __name__ == "__main__":
         raise SystemExit("--spec-k must be >= 0")
     if "dist_opt" in kwargs and which != "gpt":
         raise SystemExit("--dist-opt applies to the gpt bench")
+    if "comm_dtype" in kwargs and which != "gpt":
+        raise SystemExit("--comm-dtype applies to the gpt bench")
     if "packed_update" in kwargs and which != "gpt":
         raise SystemExit("--packed-update applies to the gpt bench")
     if kwargs.get("dist_opt") and kwargs.get("seq_parallel"):

@@ -76,6 +76,15 @@ def _observability_args(parser):
              "halve/grow logic reads the optimizer-reported flag",
     )
     g2.add_argument(
+        "--comm-dtype", default="fp32", choices=("fp32", "int8"),
+        help="wire dtype for the ring collectives: int8 quantizes each "
+             "hop with per-row fp32 scale sidecars "
+             "(ops/quantized_collectives.py) — under --dist-opt the "
+             "ZeRO grad reduce-scatter and param all-gather, under "
+             "--collective-matmul the TP-boundary rings; fp32 keeps "
+             "the plain full-precision collectives",
+    )
+    g2.add_argument(
         "--packed-update", action="store_true",
         help="run the optimizer step over packed dtype-group buffers "
              "(optimizers.PackedOptimizerStep): one-pass unscale + "
@@ -124,6 +133,9 @@ def main():
             args.collective_matmul
             and args.async_tensor_model_parallel_allreduce
         ),
+        comm_dtype=(
+            args.comm_dtype if args.collective_matmul else "fp32"
+        ),
     )
     model = GPTModel(cfg)
     if args.packed_update and not args.dist_opt:
@@ -140,6 +152,7 @@ def main():
             # found_inf must agree across TP ranks too: the probe sees
             # only this rank's grad shards
             probe_sync_axes=(parallel_state.TENSOR_AXIS,),
+            comm_dtype=args.comm_dtype,
         )
         if args.dist_opt else None
     )

@@ -29,6 +29,22 @@ rounds, so precision loss does not compound across steps: after every
 step the model params equal wire_dtype(master), the reference's
 params-from-master contract.
 
+``comm_dtype="int8"`` goes one step further and replaces BOTH
+collectives with the quantized ppermute rings of
+ops/quantized_collectives.py (EQuARX, arXiv 2506.17615): each hop's
+payload is int8 with per-row fp32 scales riding as a sidecar — ~4x
+fewer wire bytes than the fp32 one-shot collectives on the same
+64-row-aligned packed buffers, measurable via `monitor.audit`'s
+per-dtype byte split. The unscale+probe ordering above becomes load-
+bearing: quantization saturates inf, so found_inf MUST be read off the
+pre-reduce local grads (it is).
+
+Overflow steps skip the param all-gather entirely: the update kernels
+freeze the masters bitwise, so the gathered result is exactly the
+previous params and the updates are exactly zero — a `lax.cond` emits
+the zeros without moving a byte (previously the gather still ran on
+skipped steps, pure wasted wire).
+
 Knob collapse relative to the reference (SURVEY.md §7): the
 blocks/chunks/process-group plumbing (`dwu_num_blocks=4,
 dwu_num_chunks=4`, rs/ar/ag group counts, reference
@@ -78,6 +94,11 @@ from rocm_apex_tpu.ops import optim_kernels
 from rocm_apex_tpu.ops.multi_tensor import row_sumsq
 from rocm_apex_tpu.ops.optim_kernels import BLOCK_ROWS
 from rocm_apex_tpu.ops.packing import group_segment_ids, respec
+from rocm_apex_tpu.ops.quantized_collectives import (
+    check_comm_dtype,
+    ring_all_gather,
+    ring_reduce_scatter,
+)
 from rocm_apex_tpu.optimizers import _common as c
 from rocm_apex_tpu.transformer import parallel_state
 from rocm_apex_tpu.utils.compat import axis_size
@@ -143,18 +164,33 @@ def _master_shards(spec, params, axis_name):
     return tuple(shards)
 
 
-def _scatter_grads(pg, dims, axis_name, world, predivide):
-    """reduce-scatter each fp32 grad buffer into this rank's shard."""
+def _scatter_grads(pg, dims, axis_name, world, predivide, comm_dtype="fp32"):
+    """reduce-scatter each fp32 grad buffer into this rank's shard.
+
+    ``comm_dtype="int8"`` swaps the one-shot `psum_scatter` for the
+    quantized ppermute ring (ops/quantized_collectives.py) — the
+    `_shard_meta` row padding is a multiple of BLOCK_ROWS·world, so the
+    ring always tiles and the degradation path never triggers here.
+    The fused unscale + found_inf probe runs BEFORE this on the full
+    local grads (module header), which is load-bearing for the int8
+    wire: quantization saturates inf to ±127 and would hide overflow
+    from any post-reduce probe.
+    """
     shards = []
     for gbuf, (rows_pad, _) in zip(pg.buffers, dims):
         g = _pad_rows_to(gbuf, rows_pad)
         if predivide:
             g = g / world
-        shards.append(
-            jax.lax.psum_scatter(
-                g, axis_name, scatter_dimension=0, tiled=True
+        if comm_dtype == "int8":
+            shards.append(
+                ring_reduce_scatter(g, axis_name, dim=0, comm_dtype="int8")
             )
-        )
+        else:
+            shards.append(
+                jax.lax.psum_scatter(
+                    g, axis_name, scatter_dimension=0, tiled=True
+                )
+            )
     return shards
 
 
@@ -175,11 +211,38 @@ def _wire_dtype(allgather_dtype):
         ) from None
 
 
-def _emit_updates(spec, pp, new_masters, dims, axis_name, wire=None):
+def _emit_updates(spec, pp, new_masters, dims, axis_name, rank, wire=None,
+                  comm_dtype="fp32"):
     """all-gather new master shards in the wire dtype; updates make
-    p + u == wire_dtype(master) (== cast(master) for fp32 wire)."""
+    p + u == wire_dtype(master) (== cast(master) for fp32 wire).
+
+    ``comm_dtype="int8"`` routes the gather through the quantized
+    ppermute ring instead — but it ships the DELTA (master − current
+    param shard), not the master value. Deltas are lr-scale, so the
+    per-row int8 grid is ~lr/127 fine where quantizing the master
+    value itself would put an O(|param|/127) error on every element.
+    Because each rank's delta is computed against the live param
+    buffer, any residual from the previous step's quantization is part
+    of the next step's delta — built-in error feedback: |master − p|
+    stays bounded at one quantization step of the lr-scale grid
+    instead of accumulating. Every rank dequantizes the SAME ring
+    payloads and every rank computes the same (replicated) param
+    shards, so params stay bitwise replicated — the int8 analogue of
+    the reference's e5m2 compressed gather.
+    """
     deltas = []
-    for pbuf, master, (rows_pad, _) in zip(pp.buffers, new_masters, dims):
+    for pbuf, master, (rows_pad, shard_rows) in zip(
+        pp.buffers, new_masters, dims
+    ):
+        if comm_dtype == "int8":
+            pshard = _slice_shard(
+                _pad_rows_to(pbuf.astype(jnp.float32), rows_pad),
+                rank, shard_rows,
+            )
+            full = ring_all_gather(master - pshard, axis_name, dim=0,
+                                   comm_dtype="int8")
+            deltas.append(full[: pbuf.shape[0]].astype(jnp.float32))
+            continue
         if wire is None:
             send = master
         else:
@@ -192,6 +255,35 @@ def _emit_updates(spec, pp, new_masters, dims, axis_name, wire=None):
         full = full[: pbuf.shape[0]].astype(jnp.float32)
         deltas.append(full - pbuf.astype(jnp.float32))
     return c.deltas_to_updates(spec, deltas)
+
+
+def _emit_or_freeze(spec, pp, new_masters, dims, axis_name, rank, wire,
+                    comm_dtype, found_inf):
+    """The post-step param gather, skipped entirely on overflow steps.
+
+    On a found_inf step the masters freeze bitwise (the kernels emit
+    exactly-zero deltas), so the gathered result is knowable without
+    moving a byte: params already equal wire(master) from the previous
+    step, hence updates are exactly zero. `lax.cond` keeps the gather
+    out of the executed path — before this, a skipped step still paid
+    the full all-gather wire cost for a guaranteed no-op result.
+    """
+    def _gather(masters):
+        return _emit_updates(spec, pp, list(masters), dims, axis_name,
+                             rank, wire, comm_dtype)
+
+    if found_inf is None:
+        return _gather(tuple(new_masters))
+
+    def _frozen(masters):
+        del masters
+        zeros = [
+            jnp.zeros((pbuf.shape[0], optim_kernels.WIDTH), jnp.float32)
+            for pbuf in pp.buffers
+        ]
+        return c.deltas_to_updates(spec, zeros)
+
+    return jax.lax.cond(found_inf, _frozen, _gather, tuple(new_masters))
 
 
 def _wd_shards(spec, weight_decay, mask, dims, rank):
@@ -246,6 +338,7 @@ def distributed_fused_adam(
     max_grad_norm: float = 0.0,
     predivide: bool = True,
     allgather_dtype: str = "fp32",
+    comm_dtype: str = "fp32",
     axis_name: str = parallel_state.DATA_AXIS,
     probe_sync_axes: Tuple[str, ...] = (),
 ) -> optax.GradientTransformation:
@@ -258,9 +351,20 @@ def distributed_fused_adam(
     `update(..., inv_scale=, with_info=True)` composes the amp loss
     scaler (module header); `probe_sync_axes` lists extra bound mesh
     axes (e.g. the tensor axis) the found_inf flag syncs over.
+    ``comm_dtype="int8"`` routes BOTH the grad reduce-scatter and the
+    param all-gather through the quantized ppermute rings
+    (ops/quantized_collectives.py) — ~4x fewer wire bytes per step;
+    mutually exclusive with a non-fp32 ``allgather_dtype`` (pick one
+    wire compression).
     """
     beta1, beta2 = betas
     wire = _wire_dtype(allgather_dtype)
+    check_comm_dtype(comm_dtype)
+    if comm_dtype == "int8" and wire is not None:
+        raise ValueError(
+            "comm_dtype='int8' already compresses the param gather; "
+            f"combine it with allgather_dtype='fp32', not {allgather_dtype!r}"
+        )
 
     def init_fn(params):
         spec = c.build_pack_spec(params)
@@ -298,7 +402,9 @@ def distributed_fused_adam(
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        g_shards = _scatter_grads(pg, dims, axis_name, world, predivide)
+        g_shards = _scatter_grads(
+            pg, dims, axis_name, world, predivide, comm_dtype
+        )
         gs = jnp.asarray(1.0 if grad_scale is None else grad_scale, jnp.float32)
         if not predivide:
             gs = gs / world
@@ -330,7 +436,10 @@ def distributed_fused_adam(
         else:
             count = state.count + jnp.logical_not(found_inf).astype(jnp.int32)
 
-        updates = _emit_updates(spec, pp, new_master, dims, axis_name, wire)
+        updates = _emit_or_freeze(
+            spec, pp, new_master, dims, axis_name, rank, wire, comm_dtype,
+            found_inf,
+        )
         new_state = DistributedAdamState(
             count=count,
             master=tuple(new_master),
@@ -364,6 +473,7 @@ def distributed_fused_lamb(
     grad_scale: Optional[Any] = None,
     predivide: bool = True,
     allgather_dtype: str = "fp32",
+    comm_dtype: str = "fp32",
     axis_name: str = parallel_state.DATA_AXIS,
     probe_sync_axes: Tuple[str, ...] = (),
 ) -> optax.GradientTransformation:
@@ -374,10 +484,18 @@ def distributed_fused_lamb(
     axis, exactly reproducing the unsharded `fused_lamb` math
     (reference: apex/contrib/optimizers/distributed_fused_lamb.py:6-910,
     whose per-tensor norms ride a dedicated l2-norm kernel + allreduce).
+    ``comm_dtype="int8"`` quantizes the grad reduce-scatter and param
+    all-gather rings exactly as in `distributed_fused_adam`.
     """
     beta1, beta2 = betas
     beta3 = 1.0 - beta1 if grad_averaging else 1.0
     wire = _wire_dtype(allgather_dtype)
+    check_comm_dtype(comm_dtype)
+    if comm_dtype == "int8" and wire is not None:
+        raise ValueError(
+            "comm_dtype='int8' already compresses the param gather; "
+            f"combine it with allgather_dtype='fp32', not {allgather_dtype!r}"
+        )
 
     def init_fn(params):
         spec = c.build_pack_spec(params)
@@ -415,7 +533,9 @@ def distributed_fused_lamb(
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        g_shards = _scatter_grads(pg, dims, axis_name, world, predivide)
+        g_shards = _scatter_grads(
+            pg, dims, axis_name, world, predivide, comm_dtype
+        )
         gs = jnp.asarray(1.0 if grad_scale is None else grad_scale, jnp.float32)
         if not predivide:
             gs = gs / world
@@ -485,7 +605,10 @@ def distributed_fused_lamb(
         else:
             count = state.count + jnp.logical_not(found_inf).astype(jnp.int32)
 
-        updates = _emit_updates(spec, pp, new_master, dims, axis_name, wire)
+        updates = _emit_or_freeze(
+            spec, pp, new_master, dims, axis_name, rank, wire, comm_dtype,
+            found_inf,
+        )
         new_state = DistributedLAMBState(
             count=count,
             master=tuple(new_master),
@@ -520,6 +643,7 @@ class DistributedFusedAdam(c.FusedOptimizer):
         max_grad_norm: float = 0.0,
         predivide: bool = True,
         allgather_dtype: str = "fp32",
+        comm_dtype: str = "fp32",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
         probe_sync_axes: Tuple[str, ...] = (),
@@ -540,6 +664,7 @@ class DistributedFusedAdam(c.FusedOptimizer):
                 max_grad_norm=max_grad_norm,
                 predivide=predivide,
                 allgather_dtype=allgather_dtype,
+                comm_dtype=comm_dtype,
                 axis_name=axis_name,
                 probe_sync_axes=probe_sync_axes,
             )
@@ -563,6 +688,7 @@ class DistributedFusedLAMB(c.FusedOptimizer):
         use_nvlamb: bool = False,
         predivide: bool = True,
         allgather_dtype: str = "fp32",
+        comm_dtype: str = "fp32",
         weight_decay_mask: Optional[Any] = None,
         axis_name: str = parallel_state.DATA_AXIS,
         probe_sync_axes: Tuple[str, ...] = (),
@@ -584,6 +710,7 @@ class DistributedFusedLAMB(c.FusedOptimizer):
                 use_nvlamb=use_nvlamb,
                 predivide=predivide,
                 allgather_dtype=allgather_dtype,
+                comm_dtype=comm_dtype,
                 weight_decay_mask=weight_decay_mask,
                 axis_name=axis_name,
                 probe_sync_axes=probe_sync_axes,

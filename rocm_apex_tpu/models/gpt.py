@@ -133,6 +133,11 @@ class GPTConfig:
     # ring piece size in rows (None = one piece per shard; a chunk
     # that does not tile the shard falls back to the plain collective)
     collective_matmul_chunk: Optional[int] = None
+    # wire dtype for the collective-matmul rings: "int8" quantizes each
+    # ring hop's payload with per-row fp32 scale sidecars
+    # (ops/quantized_collectives.py); only meaningful with
+    # collective_matmul=True — the plain lax collectives stay fp32
+    comm_dtype: str = "fp32"
     # activation-RMS telemetry taps (rocm_apex_tpu.monitor): each layer
     # sows the RMS of its attention and MLP outputs (and the model the
     # final hidden state) into the "intermediates" collection as
@@ -188,6 +193,7 @@ def _sp_kwargs(cfg: GPTConfig, tp: int) -> dict:
         sequence_parallel=True,
         collective_matmul=cfg.collective_matmul,
         collective_matmul_chunk=cfg.collective_matmul_chunk,
+        comm_dtype=cfg.comm_dtype,
     )
 
 

@@ -30,7 +30,24 @@ clever):
 * **bytes** per collective = the payload (sum of output-aval bytes),
   NOT wire bytes — ring/algorithm factors (the 2(n−1)/n of an
   all-reduce) depend on the implementation the compiler picks and are
-  not knowable from the jaxpr.
+  not knowable from the jaxpr. ``bytes_by_dtype()`` splits the same
+  payload totals by element dtype, which is how an int8-quantized ring
+  (ops/quantized_collectives.py) shows its byte win next to the fp32
+  scale sidecar it ships alongside.
+* **wire_bytes** per collective = a ring-algorithm traffic ESTIMATE:
+  `ppermute` payloads are exact wire bytes by construction; tiled
+  `all_gather` / `reduce_scatter` carry their ``axis_size`` n in the
+  jaxpr params, so the per-link ring traffic is out·(n−1)/n resp.
+  in·(n−1)/n. Reduction collectives without a size param (`psum`,
+  `pmax`, ...) fall back to the payload — a floor, flagged as such.
+  This is the apples-to-apples number for comparing a one-equation
+  lax collective against the ppermute ring that replaces it (the
+  payload convention would credit `psum_scatter` with 1/n of the
+  bytes its wire actually moves).
+* **scopes**: every collective is also attributed to the
+  `jax.named_scope` stack enclosing its equation
+  (``count_in_scope``), so a ring's 2m(n−1) ppermute hops are
+  distinguishable from one-shot collectives in the same program.
 * **dot_flops** = 2·|out|·k per `dot_general` (MAC-counting, the
   profiler's convention), trip-count multiplied.
 * **shapes** is the set of every intermediate (equation-output) aval
@@ -87,6 +104,18 @@ class AuditReport:
     shapes: FrozenSet[Tuple[int, ...]]
     eqn_count: float = 0.0
     while_lower_bound: bool = False
+    # (primitive, dtype-name) -> payload bytes of that element dtype
+    dtype_bytes: Dict[Tuple[str, str], float] = dataclasses.field(
+        default_factory=dict
+    )
+    # (named_scope path, primitive) -> execution count
+    scope_counts: Dict[Tuple[str, str], float] = dataclasses.field(
+        default_factory=dict
+    )
+    # primitive -> estimated per-link ring wire bytes (module docstring)
+    wire_bytes_moved: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
 
     # -- accessors ------------------------------------------------------
 
@@ -98,6 +127,36 @@ class AuditReport:
         name = _ALIASES.get(name, name)
         return float(self.bytes_moved.get(name, 0.0))
 
+    def bytes_by_dtype(self, name: str) -> Dict[str, float]:
+        """Payload bytes of collective ``name`` split by element dtype,
+        e.g. ``{"int8": 196608, "float32": 768}`` for a quantized ring
+        and its fp32 scale sidecar."""
+        name = _ALIASES.get(name, name)
+        return {
+            dt: float(b)
+            for (p, dt), b in sorted(self.dtype_bytes.items())
+            if p == name
+        }
+
+    def wire_bytes(self, name: str) -> float:
+        """Estimated ring wire bytes for collective ``name`` (exact for
+        ppermute, out·(n−1)/n / in·(n−1)/n for tiled gather/scatter,
+        payload floor for size-less reductions)."""
+        name = _ALIASES.get(name, name)
+        return float(self.wire_bytes_moved.get(name, 0.0))
+
+    def count_in_scope(self, scope: str, name: str) -> int:
+        """Executions of collective ``name`` whose enclosing
+        `jax.named_scope` path contains ``scope`` as a substring."""
+        name = _ALIASES.get(name, name)
+        return int(
+            sum(
+                v
+                for (sc, p), v in self.scope_counts.items()
+                if p == name and scope in sc
+            )
+        )
+
     @property
     def collective_count(self) -> int:
         return int(sum(self.counts.values()))
@@ -105,6 +164,10 @@ class AuditReport:
     @property
     def collective_bytes(self) -> float:
         return float(sum(self.bytes_moved.values()))
+
+    @property
+    def collective_wire_bytes(self) -> float:
+        return float(sum(self.wire_bytes_moved.values()))
 
     def has_intermediate(self, shape) -> bool:
         """True iff some equation anywhere in the program OUTPUTS an
@@ -121,14 +184,28 @@ class AuditReport:
 
     def summary(self) -> str:
         """Human-readable table (the bench --audit report body)."""
-        lines = ["collective            count        MB payload"]
+        lines = [
+            "collective            count        MB payload        MB wire"
+        ]
         for name in sorted(self.counts):
             lines.append(
                 f"{name:<20} {int(self.counts[name]):>6} "
-                f"{self.bytes_moved.get(name, 0.0) / 1e6:>13.3f}"
+                f"{self.bytes_moved.get(name, 0.0) / 1e6:>13.3f} "
+                f"{self.wire_bytes_moved.get(name, 0.0) / 1e6:>13.3f}"
             )
+            by_dt = self.bytes_by_dtype(name)
+            if len(by_dt) > 1:
+                for dt, b in by_dt.items():
+                    lines.append(f"  .{dt:<17} {'':>6} {b / 1e6:>13.3f}")
         if not self.counts:
             lines.append("(none)")
+        scoped = sorted(
+            (sc, p, v) for (sc, p), v in self.scope_counts.items() if sc
+        )
+        if scoped:
+            lines.append("by named_scope:")
+            for sc, p, v in scoped:
+                lines.append(f"  {sc:<30} {p:<16} x{int(v)}")
         lines.append(
             f"dot_general: {int(self.dot_count)} ops, "
             f"{self.dot_flops / 1e9:.3f} GFLOP"
@@ -148,14 +225,51 @@ def _aval_bytes(aval) -> float:
         return 0.0
 
 
-def _merge(dst: Dict[str, float], src: Dict[str, float], scale: float):
+def _merge(dst: Dict[Any, float], src: Dict[Any, float], scale: float):
     for k, v in src.items():
         dst[k] = dst.get(k, 0.0) + v * scale
 
 
-def _merge_max(dst: Dict[str, float], src: Dict[str, float]):
+def _merge_max(dst: Dict[Any, float], src: Dict[Any, float]):
     for k, v in src.items():
         dst[k] = max(dst.get(k, 0.0), v)
+
+
+def _eqn_scope(eqn) -> str:
+    """The `jax.named_scope` path enclosing this equation, '' if none
+    (or on jax versions without source_info name stacks)."""
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:  # noqa: BLE001 - defensive across jax versions
+        return ""
+
+
+def _scope_join(outer: str, inner: str) -> str:
+    if outer and inner:
+        return f"{outer}/{inner}"
+    return outer or inner
+
+
+def _prefix_scopes(
+    src: Dict[Tuple[str, str], float], outer: str
+) -> Dict[Tuple[str, str], float]:
+    if not outer:
+        return src
+    return {(_scope_join(outer, sc), p): v for (sc, p), v in src.items()}
+
+
+def _wire_estimate(name, eqn, payload: float) -> float:
+    """Per-link ring wire-byte estimate (AuditReport docstring)."""
+    if name == "ppermute":
+        return payload
+    n = eqn.params.get("axis_size")
+    if n and n > 0:
+        if name == "all_gather":
+            return payload * (n - 1) / n
+        if name == "reduce_scatter":
+            in_bytes = sum(_aval_bytes(iv.aval) for iv in eqn.invars)
+            return in_bytes * (n - 1) / n
+    return payload
 
 
 def _inner_jaxprs(params):
@@ -174,6 +288,9 @@ def _walk(jaxpr) -> AuditReport:
         jaxpr = jaxpr.jaxpr
     counts: Dict[str, float] = {}
     nbytes: Dict[str, float] = {}
+    dtype_bytes: Dict[Tuple[str, str], float] = {}
+    scope_counts: Dict[Tuple[str, str], float] = {}
+    wire: Dict[str, float] = {}
     dot_flops = 0.0
     dot_count = 0.0
     eqns_total = 0.0
@@ -190,8 +307,22 @@ def _walk(jaxpr) -> AuditReport:
 
         if name in _COLLECTIVES:
             counts[name] = counts.get(name, 0.0) + 1.0
-            nbytes[name] = nbytes.get(name, 0.0) + sum(
-                _aval_bytes(ov.aval) for ov in eqn.outvars
+            payload = sum(_aval_bytes(ov.aval) for ov in eqn.outvars)
+            nbytes[name] = nbytes.get(name, 0.0) + payload
+            for ov in eqn.outvars:
+                aval = getattr(ov, "aval", None)
+                try:
+                    dt = str(np.dtype(aval.dtype))
+                except Exception:  # noqa: BLE001 - token/opaque avals
+                    dt = "?"
+                key = (name, dt)
+                dtype_bytes[key] = dtype_bytes.get(key, 0.0) + _aval_bytes(
+                    aval
+                )
+            sckey = (_eqn_scope(eqn), name)
+            scope_counts[sckey] = scope_counts.get(sckey, 0.0) + 1.0
+            wire[name] = wire.get(name, 0.0) + _wire_estimate(
+                name, eqn, payload
             )
             continue
         if name == "dot_general":
@@ -208,15 +339,24 @@ def _walk(jaxpr) -> AuditReport:
         inner = list(_inner_jaxprs(eqn.params))
         if not inner:
             continue
+        outer_scope = _eqn_scope(eqn)
         if name == "cond":
             # one branch executes: merge branch audits by max
             b_counts: Dict[str, float] = {}
             b_bytes: Dict[str, float] = {}
+            b_dtype: Dict[Tuple[str, str], float] = {}
+            b_scopes: Dict[Tuple[str, str], float] = {}
+            b_wire: Dict[str, float] = {}
             b_flops = b_dots = b_eqns = 0.0
             for br in inner:
                 r = _walk(br)
                 _merge_max(b_counts, r.counts)
                 _merge_max(b_bytes, r.bytes_moved)
+                _merge_max(b_dtype, r.dtype_bytes)
+                _merge_max(
+                    b_scopes, _prefix_scopes(r.scope_counts, outer_scope)
+                )
+                _merge_max(b_wire, r.wire_bytes_moved)
                 b_flops = max(b_flops, r.dot_flops)
                 b_dots = max(b_dots, r.dot_count)
                 b_eqns = max(b_eqns, r.eqn_count)
@@ -224,6 +364,9 @@ def _walk(jaxpr) -> AuditReport:
                 lower_bound |= r.while_lower_bound
             _merge(counts, b_counts, 1.0)
             _merge(nbytes, b_bytes, 1.0)
+            _merge(dtype_bytes, b_dtype, 1.0)
+            _merge(scope_counts, b_scopes, 1.0)
+            _merge(wire, b_wire, 1.0)
             dot_flops += b_flops
             dot_count += b_dots
             eqns_total += b_eqns
@@ -238,6 +381,13 @@ def _walk(jaxpr) -> AuditReport:
             r = _walk(sub)
             _merge(counts, r.counts, scale)
             _merge(nbytes, r.bytes_moved, scale)
+            _merge(dtype_bytes, r.dtype_bytes, scale)
+            _merge(
+                scope_counts,
+                _prefix_scopes(r.scope_counts, outer_scope),
+                scale,
+            )
+            _merge(wire, r.wire_bytes_moved, scale)
             dot_flops += r.dot_flops * scale
             dot_count += r.dot_count * scale
             eqns_total += r.eqn_count * scale
@@ -252,6 +402,9 @@ def _walk(jaxpr) -> AuditReport:
         shapes=frozenset(shapes),
         eqn_count=eqns_total,
         while_lower_bound=lower_bound,
+        dtype_bytes=dtype_bytes,
+        scope_counts=scope_counts,
+        wire_bytes_moved=wire,
     )
 
 

@@ -35,6 +35,20 @@ sums stay fp32 until the final cast). Both degrade to the plain `lax`
 collective + dot when the axis is unbound, ``axis_size == 1``, or
 ``chunk`` does not tile the shard — same numerics, no ring.
 
+``comm_dtype="int8"`` (ops/quantized_collectives.py; EQuARX, arXiv
+2506.17615) quantizes the ring hop payloads: the gather rings quantize
+each rotating piece ONCE (per-row fp32 scales ride a sidecar ppermute)
+and dequantize on arrival for the dot, so the int8-gather-matmul
+equals ``dequant(int8(all_gather(x))) @ w`` slot-for-slot; the
+reduce-scatter ring re-quantizes its rotating fp32 accumulator per hop
+and adds the local partial product in full fp32. The backward rings
+stay exact transposes of each other at the SAME comm dtype (dx of an
+int8 gather-matmul is an int8 matmul-reduce-scatter with ``wᵀ``); the
+degradation paths stay full-precision plain collectives. This is the
+sequence-parallel entry/exit knob — opt-in, activation-quantization
+noise is ~1% per hop payload row, acceptable for SP boundary
+activations, not for logits.
+
 The rows axis is ``-2`` (the flattened-token axis of a ``(rows, h)``
 activation, or the sequence axis of ``(b, s, h)``); the contraction is
 the last axis against ``w``'s first.
@@ -46,6 +60,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from rocm_apex_tpu.ops.quantized_collectives import (
+    check_comm_dtype,
+    dequantize_int8,
+    quantize_int8,
+)
 from rocm_apex_tpu.utils.compat import axis_size
 
 __all__ = ["all_gather_matmul", "matmul_reduce_scatter"]
@@ -90,7 +109,35 @@ def _plain_ag_mm(x, w, axis_name):
     return _mm(x, w).astype(_out_dtype(x, w))
 
 
-def _ring_ag_mm(x, w, axis_name, m):
+def _rotating_pieces(x, m, chunk, ax, comm_dtype):
+    """Split a gather-ring operand into its rotating payloads: raw
+    slices for fp32, `(q, scale)` pairs — quantized ONCE — for int8."""
+    pieces = []
+    for j in range(m):
+        piece = jax.lax.slice_in_dim(x, j * chunk, (j + 1) * chunk, axis=ax)
+        pieces.append(
+            quantize_int8(piece) if comm_dtype == "int8" else piece
+        )
+    return pieces
+
+
+def _rotate_and_land(payload, axis_name, perm, rotate, comm_dtype, dtype):
+    """One gather-ring hop: forward the payload (when ``rotate``) and
+    return (next_payload_or_None, landed array in ``dtype``)."""
+    if comm_dtype == "int8":
+        q, s = payload
+        nxt = None
+        if rotate:
+            nxt = (
+                jax.lax.ppermute(q, axis_name, perm),
+                jax.lax.ppermute(s, axis_name, perm),
+            )
+        return nxt, dequantize_int8(q, s, dtype)
+    nxt = jax.lax.ppermute(payload, axis_name, perm) if rotate else None
+    return nxt, payload
+
+
+def _ring_ag_mm(x, w, axis_name, m, comm_dtype="fp32"):
     """Ring all-gather fused with the matmul: at hop i the resident
     shard (originally rank ``idx + i``'s) multiplies into its output
     slot, piece by piece, while each piece already permutes onward for
@@ -105,29 +152,29 @@ def _ring_ag_mm(x, w, axis_name, m):
     out = jnp.zeros(
         x.shape[:-2] + (n * rows, w.shape[-1]), _out_dtype(x, w)
     )
-    cur = x
+    pieces = _rotating_pieces(x, m, chunk, ax, comm_dtype)
     for i in range(n):
         src = (idx + i) % n
         nxt = []
-        for j in range(m):
-            piece = jax.lax.slice_in_dim(
-                cur, j * chunk, (j + 1) * chunk, axis=ax
+        for j, payload in enumerate(pieces):
+            # issue the transfer BEFORE this piece's dot: XLA's
+            # async collective-permute runs under the MXU work
+            fwd, piece = _rotate_and_land(
+                payload, axis_name, perm, i + 1 < n, comm_dtype, x.dtype
             )
-            if i + 1 < n:
-                # issue the transfer BEFORE this piece's dot: XLA's
-                # async collective-permute runs under the MXU work
-                nxt.append(jax.lax.ppermute(piece, axis_name, perm))
+            if fwd is not None:
+                nxt.append(fwd)
             part = _mm(piece, w).astype(out.dtype)
             out = jax.lax.dynamic_update_slice_in_dim(
                 out, part, src * rows + j * chunk, axis=ax
             )
         if nxt:
-            cur = jnp.concatenate(nxt, axis=ax)
+            pieces = nxt
     return out
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def all_gather_matmul(x, w, axis_name, chunk=None):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def all_gather_matmul(x, w, axis_name, chunk=None, comm_dtype="fp32"):
     """``all_gather(x, axis=-2) @ w`` with the gather decomposed into a
     ppermute ring whose hops overlap the partial matmuls.
 
@@ -138,24 +185,29 @@ def all_gather_matmul(x, w, axis_name, chunk=None):
       chunk: rows per ring piece (must tile ``rows_local``; None = one
         piece per shard). A non-tiling chunk falls back to the plain
         ``lax.all_gather`` + dot.
+      comm_dtype: "fp32" (default) moves hop payloads untouched;
+        "int8" quantizes each rotating piece once with per-row fp32
+        scale sidecars (module docstring). Degradation paths stay
+        full-precision.
 
     Returns ``(..., axis_size * rows_local, n)``. The gathered ``x``
     never materializes on the ring path.
     """
+    check_comm_dtype(comm_dtype)
     n = _bound_axis_size(axis_name)
     if n is None or n == 1:
         return _mm(x, w).astype(_out_dtype(x, w))
     m = _ring_chunks(x.shape[-2], chunk)
     if m is None:
         return _plain_ag_mm(x, w, axis_name)
-    return _ring_ag_mm(x, w, axis_name, m)
+    return _ring_ag_mm(x, w, axis_name, m, comm_dtype)
 
 
-def _ag_mm_fwd(x, w, axis_name, chunk):
-    return all_gather_matmul(x, w, axis_name, chunk), (x, w)
+def _ag_mm_fwd(x, w, axis_name, chunk, comm_dtype):
+    return all_gather_matmul(x, w, axis_name, chunk, comm_dtype), (x, w)
 
 
-def _ring_dw_from_gather(x, dy, axis_name, m):
+def _ring_dw_from_gather(x, dy, axis_name, m, comm_dtype="fp32"):
     """dW = all_gather(x)ᵀ @ dy without materializing the gather: the
     saved local shard re-rotates and each hop contracts against its
     own slice of the cotangent."""
@@ -166,16 +218,16 @@ def _ring_dw_from_gather(x, dy, axis_name, m):
     ax = x.ndim - 2
     perm = [(j, (j - 1) % n) for j in range(n)]
     dw = jnp.zeros(x.shape[-1:] + dy.shape[-1:], jnp.float32)
-    cur = x
+    pieces = _rotating_pieces(x, m, chunk, ax, comm_dtype)
     for i in range(n):
         src = (idx + i) % n
         nxt = []
-        for j in range(m):
-            piece = jax.lax.slice_in_dim(
-                cur, j * chunk, (j + 1) * chunk, axis=ax
+        for j, payload in enumerate(pieces):
+            fwd, piece = _rotate_and_land(
+                payload, axis_name, perm, i + 1 < n, comm_dtype, x.dtype
             )
-            if i + 1 < n:
-                nxt.append(jax.lax.ppermute(piece, axis_name, perm))
+            if fwd is not None:
+                nxt.append(fwd)
             dy_piece = jax.lax.dynamic_slice_in_dim(
                 dy, src * rows + j * chunk, chunk, axis=ax
             )
@@ -184,11 +236,11 @@ def _ring_dw_from_gather(x, dy, axis_name, m):
                 preferred_element_type=jnp.float32,
             )
         if nxt:
-            cur = jnp.concatenate(nxt, axis=ax)
+            pieces = nxt
     return dw
 
 
-def _ag_mm_bwd(axis_name, chunk, res, dy):
+def _ag_mm_bwd(axis_name, chunk, comm_dtype, res, dy):
     x, w = res
     n = _bound_axis_size(axis_name)
     if n is None or n == 1:
@@ -210,9 +262,13 @@ def _ag_mm_bwd(axis_name, chunk, res, dy):
         ).astype(w.dtype)
         return dx, dw
     # the transposed gather IS a matmul-reduce-scatter: same ring, same
-    # overlap, wᵀ as the operand
-    dx = _ring_mm_rs(dy, w.swapaxes(-1, -2), axis_name, m).astype(x.dtype)
-    dw = _ring_dw_from_gather(x, dy, axis_name, m).astype(w.dtype)
+    # overlap, same comm dtype, wᵀ as the operand
+    dx = _ring_mm_rs(
+        dy, w.swapaxes(-1, -2), axis_name, m, comm_dtype
+    ).astype(x.dtype)
+    dw = _ring_dw_from_gather(x, dy, axis_name, m, comm_dtype).astype(
+        w.dtype
+    )
     return dx, dw
 
 
@@ -232,7 +288,19 @@ def _plain_mm_rs(x, w, axis_name):
     return y.astype(_out_dtype(x, w))
 
 
-def _ring_mm_rs(x, w, axis_name, m):
+def _acc_hop(acc, axis_name, perm, comm_dtype):
+    """One reduce-scatter-ring hop of the fp32 accumulator: int8 mode
+    re-quantizes per hop (the value changes every hop), fp32 mode moves
+    it untouched."""
+    if comm_dtype == "int8":
+        q, s = quantize_int8(acc)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        s = jax.lax.ppermute(s, axis_name, perm)
+        return dequantize_int8(q, s)
+    return jax.lax.ppermute(acc, axis_name, perm)
+
+
+def _ring_mm_rs(x, w, axis_name, m, comm_dtype="fp32"):
     """Reduce-scatter fused with the matmul: a rotating fp32
     accumulator picks up each rank's partial product for one row block
     per hop and lands on the block's owner after the last hop. The
@@ -257,14 +325,14 @@ def _ring_mm_rs(x, w, axis_name, m):
             if acc[j] is not None:
                 # rotate first, then add this rank's partial — the
                 # permute of piece j hides under piece j+1's dot
-                acc[j] = jax.lax.ppermute(acc[j], axis_name, perm)
+                acc[j] = _acc_hop(acc[j], axis_name, perm, comm_dtype)
             part = _mm(piece, w)
             acc[j] = part if acc[j] is None else acc[j] + part
     return jnp.concatenate(acc, axis=ax).astype(_out_dtype(x, w))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def matmul_reduce_scatter(x, w, axis_name, chunk=None):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def matmul_reduce_scatter(x, w, axis_name, chunk=None, comm_dtype="fp32"):
     """``psum_scatter(x @ w, axis=-2)`` with the reduction decomposed
     into a ppermute ring of accumulators overlapping the partial
     matmuls.
@@ -277,10 +345,15 @@ def matmul_reduce_scatter(x, w, axis_name, chunk=None):
       chunk: rows per ring piece (must tile ``rows / axis_size``;
         None = one piece per destination block). A non-tiling chunk
         falls back to the plain dot + ``lax.psum_scatter``.
+      comm_dtype: "fp32" (default) rotates the fp32 accumulator
+        untouched; "int8" re-quantizes it per hop with per-row fp32
+        scale sidecars (module docstring). Degradation paths stay
+        full-precision.
 
     Returns the local row block ``(..., rows / axis_size, n)``, summed
     over the axis. Partial sums stay fp32 until the final cast.
     """
+    check_comm_dtype(comm_dtype)
     n = _bound_axis_size(axis_name)
     if n is None or n == 1:
         return _mm(x, w).astype(_out_dtype(x, w))
@@ -292,14 +365,14 @@ def matmul_reduce_scatter(x, w, axis_name, chunk=None):
     m = _ring_chunks(rows_full // n, chunk)
     if m is None:
         return _plain_mm_rs(x, w, axis_name)
-    return _ring_mm_rs(x, w, axis_name, m)
+    return _ring_mm_rs(x, w, axis_name, m, comm_dtype)
 
 
-def _mm_rs_fwd(x, w, axis_name, chunk):
-    return matmul_reduce_scatter(x, w, axis_name, chunk), (x, w)
+def _mm_rs_fwd(x, w, axis_name, chunk, comm_dtype):
+    return matmul_reduce_scatter(x, w, axis_name, chunk, comm_dtype), (x, w)
 
 
-def _ring_dw_from_scatter(x, dy, axis_name, m):
+def _ring_dw_from_scatter(x, dy, axis_name, m, comm_dtype="fp32"):
     """dW = xᵀ @ all_gather(dy) without the gather: the local
     cotangent block rotates and contracts against the matching row
     slice of the saved full-rows operand."""
@@ -310,16 +383,16 @@ def _ring_dw_from_scatter(x, dy, axis_name, m):
     ax = dy.ndim - 2
     perm = [(j, (j - 1) % n) for j in range(n)]
     dw = jnp.zeros(x.shape[-1:] + dy.shape[-1:], jnp.float32)
-    cur = dy
+    pieces = _rotating_pieces(dy, m, chunk, ax, comm_dtype)
     for i in range(n):
         src = (idx + i) % n
         nxt = []
-        for j in range(m):
-            piece = jax.lax.slice_in_dim(
-                cur, j * chunk, (j + 1) * chunk, axis=ax
+        for j, payload in enumerate(pieces):
+            fwd, piece = _rotate_and_land(
+                payload, axis_name, perm, i + 1 < n, comm_dtype, dy.dtype
             )
-            if i + 1 < n:
-                nxt.append(jax.lax.ppermute(piece, axis_name, perm))
+            if fwd is not None:
+                nxt.append(fwd)
             x_piece = jax.lax.dynamic_slice_in_dim(
                 x, src * rows + j * chunk, chunk, axis=ax
             )
@@ -328,11 +401,11 @@ def _ring_dw_from_scatter(x, dy, axis_name, m):
                 preferred_element_type=jnp.float32,
             )
         if nxt:
-            cur = jnp.concatenate(nxt, axis=ax)
+            pieces = nxt
     return dw
 
 
-def _mm_rs_bwd(axis_name, chunk, res, dy):
+def _mm_rs_bwd(axis_name, chunk, comm_dtype, res, dy):
     x, w = res
     n = _bound_axis_size(axis_name)
     if n is None or n == 1:
@@ -351,9 +424,14 @@ def _mm_rs_bwd(axis_name, chunk, res, dy):
             "...rk,...rn->kn", x, dyg, preferred_element_type=jnp.float32
         ).astype(w.dtype)
         return dx, dw
-    # the transposed scatter IS an all-gather-matmul with wᵀ
-    dx = _ring_ag_mm(dy, w.swapaxes(-1, -2), axis_name, m).astype(x.dtype)
-    dw = _ring_dw_from_scatter(x, dy, axis_name, m).astype(w.dtype)
+    # the transposed scatter IS an all-gather-matmul with wᵀ at the
+    # same comm dtype
+    dx = _ring_ag_mm(
+        dy, w.swapaxes(-1, -2), axis_name, m, comm_dtype
+    ).astype(x.dtype)
+    dw = _ring_dw_from_scatter(x, dy, axis_name, m, comm_dtype).astype(
+        w.dtype
+    )
     return dx, dw
 
 

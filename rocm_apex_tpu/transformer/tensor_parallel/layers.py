@@ -257,7 +257,11 @@ class ColumnParallelLinear(nn.Module):
     `ops.collective_matmul.all_gather_matmul` (the gathered activation
     never materializes); ``collective_matmul_chunk`` sets the ring
     piece size in rows (None = one piece per shard; a non-tiling chunk
-    falls back to the plain collective).
+    falls back to the plain collective). ``comm_dtype="int8"``
+    quantizes each ring hop's payload to int8 with per-row fp32 scale
+    sidecars (ops/quantized_collectives.py conventions); the backward
+    rings quantize at the same dtype, and the plain/degradation paths
+    stay full-precision.
     """
 
     input_size: int
@@ -274,6 +278,7 @@ class ColumnParallelLinear(nn.Module):
     sequence_parallel: bool = False
     collective_matmul: bool = False
     collective_matmul_chunk: Optional[int] = None
+    comm_dtype: str = "fp32"
     # The reference's opt-out of its fused async comm/compute overlap
     # (layers.py:206-240, 296-300): here it disables the collective-
     # matmul ring, restoring the blocking lax collective at this edge
@@ -321,6 +326,7 @@ class ColumnParallelLinear(nn.Module):
                     kernel.astype(self.dtype),
                     self.axis_name,
                     self.collective_matmul_chunk,
+                    self.comm_dtype,
                 )
             else:
                 xg = mappings.gather_from_sequence_parallel_region(
@@ -372,7 +378,8 @@ class RowParallelLinear(nn.Module):
     ``input_is_parallel=True``. ``collective_matmul`` fuses the
     reduce-scatter into the matmul as the ppermute-chunked ring of
     `ops.collective_matmul.matmul_reduce_scatter` (the full-rows
-    pre-reduce product never materializes).
+    pre-reduce product never materializes). ``comm_dtype="int8"``
+    quantizes the rotating ring payloads as in ColumnParallelLinear.
     """
 
     input_size: int
@@ -389,6 +396,7 @@ class RowParallelLinear(nn.Module):
     sequence_parallel: bool = False
     collective_matmul: bool = False
     collective_matmul_chunk: Optional[int] = None
+    comm_dtype: str = "fp32"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
@@ -427,6 +435,7 @@ class RowParallelLinear(nn.Module):
                 kernel.astype(self.dtype),
                 self.axis_name,
                 self.collective_matmul_chunk,
+                self.comm_dtype,
             )
         else:
             y = jnp.dot(
