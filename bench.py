@@ -344,7 +344,8 @@ def bench_bert(dropout: float = 0.0, batch: int = 0, remat: bool = False):
 def bench_serve(budget: int = 0, whole_prompt: bool = False,
                 trace: str = "", paged: bool = False,
                 page_size: int = 0, kv_dtype: str = "",
-                shared_prefix: bool = False, spec_k: int = -1):
+                shared_prefix: bool = False, spec_k: int = -1,
+                chaos: int = -1):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -385,6 +386,18 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     and A/Bs paged+prefix-sharing against plain paged: same tokens,
     ``prefix_hits``/``shared_page_ratio`` > 0, and the TTFT p95 win
     reports under ``gpt_serve_ttft_ms_shared_prefix``.
+
+    ``--chaos=SEED`` runs the mixed workload once under a seeded
+    `inference.FaultPlan` (a device-step failure, a NaN-poisoned
+    logits row, probabilistic host-fetch failures, a page-allocation
+    failure on ``--paged``) with a bounded queue and a mid-run cancel,
+    then asserts the ISSUE-12 completion-accounting identity: every
+    submitted request yields exactly one completion record —
+    completed + shed + quarantined + cancelled + expired ==
+    submitted — with the mixed step still traced ONCE and, under
+    ``--paged``, every page back in the pool after the drain. Reports
+    under ``gpt_serve_chaos_survival`` (vs_baseline = completed
+    fraction). Same SEED, same schedule: a failure replays exactly.
 
     ``--spec-k=K`` A/Bs speculative decoding (n-gram self-drafting
     through the mixed step, `inference/drafting.py`) against the
@@ -607,6 +620,105 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
         dt = time.perf_counter() - t0
         gen = sum(len(r.tokens) for r in results)
         return eng, results, gen / dt, dt
+
+    if chaos >= 0:
+        from rocm_apex_tpu.inference import FINISH_REASONS, Fault, FaultPlan
+
+        kv = jnp.int8 if kv_dtype == "int8" else None
+        ps = page_size or (64 if on_tpu else 16)
+        # the schedule derives from SEED alone, so a red run replays
+        # bit-for-bit with the same command line
+        rng_c = np.random.RandomState(chaos)
+        plan = FaultPlan([
+            Fault(site="device_step", tick=int(rng_c.randint(1, 5))),
+            Fault(site="logits", tick=int(rng_c.randint(5, 10)),
+                  payload={"slot": int(rng_c.randint(0, num_slots))}),
+            Fault(site="host_fetch", p=0.05, times=2),
+            # consulted on the paged engine only; 0 fires on contiguous
+            Fault(site="page_alloc", nth=int(rng_c.randint(2, 7))),
+        ], seed=chaos)
+        eng = InferenceEngine(
+            model, params, num_slots=num_slots, capacity=capacity,
+            max_prompt_len=max(lens),
+            sampling=SamplingParams(temperature=0.0), seed=0,
+            prefill_token_budget=budget, faults=plan,
+            # p=0.05 times=2 can never out-fire 3 attempts — the plan
+            # is chaotic, not unrecoverable
+            max_step_retries=2,
+            # bounded admission: the last 2 submissions shed
+            max_queue=n_requests - 2,
+            paged=paged, page_size=ps if paged else 16,
+            kv_dtype=kv if paged else None,
+        )
+        baseline = eng._allocator.snapshot() if paged else None
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=max_new)
+        done = {}
+        for _ in range(2):
+            for r in eng.step():
+                done[r.request_id] = r
+        victim = next(
+            st.req.request_id for st in eng._slots if st is not None
+        )
+        done[victim] = eng.cancel(victim)
+        done.update(
+            {r.request_id: r for r in eng.drain()}
+        )
+        s = eng.stats()
+        shed = int(s["shed"])
+        quar = int(s["quarantined"])
+        canc = int(s["cancelled"])
+        dead = int(s["deadline_exceeded"])
+        reasons = {}
+        for c in eng.completions:
+            reasons[c["finish_reason"]] = (
+                reasons.get(c["finish_reason"], 0) + 1
+            )
+        finished_ok = sum(
+            n for why, n in reasons.items()
+            if why in ("length", "stop", "capacity")
+        )
+        # the accounting identity: one record per submission, every
+        # record a known reason, the teardown counters summing exactly
+        assert len(eng.completions) == n_requests, (
+            f"{n_requests} submitted, {len(eng.completions)} accounted"
+        )
+        assert set(reasons) <= set(FINISH_REASONS), reasons
+        assert (
+            finished_ok + shed + quar + canc + dead == n_requests
+        ), (
+            f"completion accounting leaked: {finished_ok} completed + "
+            f"{shed} shed + {quar} quarantined + {canc} cancelled + "
+            f"{dead} expired != {n_requests} submitted ({reasons})"
+        )
+        assert quar == reasons.get("error", 0)
+        assert eng.mixed_trace_count == 1, "chaos retraced the mixed step"
+        assert sum(plan.fires.values()) >= 2, (
+            f"chaos plan barely fired: {dict(plan.fires)}"
+        )
+        if paged:
+            eng._allocator.assert_consistent()
+            assert eng._allocator.snapshot() == baseline, (
+                "pages leaked across the chaos run"
+            )
+        print(
+            f"serve[chaos seed={chaos}{'/paged' if paged else ''}]: "
+            f"{finished_ok} completed, {shed} shed, {quar} "
+            f"quarantined, {canc} cancelled, {dead} expired of "
+            f"{n_requests}; retries={int(s['step_retries'])} "
+            f"fires={dict(plan.fires)} — accounting identity holds",
+            file=sys.stderr,
+        )
+        _report(
+            "gpt_serve_chaos_survival", float(finished_ok), "requests",
+            finished_ok / n_requests,
+            f"seeded chaos (seed={chaos}): completed + shed + "
+            f"quarantined + cancelled + expired == submitted "
+            f"({n_requests}); mixed step traced once; "
+            f"{'no page leak; ' if paged else ''}"
+            f"fault fires {dict(plan.fires)}",
+        )
+        return
 
     if paged or shared_prefix:
         kv = jnp.int8 if kv_dtype == "int8" else None
@@ -1740,6 +1852,8 @@ if __name__ == "__main__":
             kwargs["shared_prefix"] = True
         elif a.startswith("--spec-k="):
             kwargs["spec_k"] = int(a.split("=", 1)[1])
+        elif a.startswith("--chaos="):
+            kwargs["chaos"] = int(a.split("=", 1)[1])
         elif a == "--dist-opt":
             kwargs["dist_opt"] = True
         elif a.startswith("--comm-dtype="):
@@ -1779,14 +1893,25 @@ if __name__ == "__main__":
         or "trace" in kwargs or "paged" in kwargs
         or "page_size" in kwargs or "kv_dtype" in kwargs
         or "shared_prefix" in kwargs or "spec_k" in kwargs
+        or "chaos" in kwargs
     ) and which != "serve":
         raise SystemExit(
             "--budget/--whole-prompt/--trace/--paged/--page-size/"
-            "--kv-dtype/--shared-prefix/--spec-k apply to the serve "
-            "bench"
+            "--kv-dtype/--shared-prefix/--spec-k/--chaos apply to the "
+            "serve bench"
         )
     if kwargs.get("spec_k", 0) < 0:
         raise SystemExit("--spec-k must be >= 0")
+    if kwargs.get("chaos", 0) < 0:
+        raise SystemExit("--chaos takes a seed >= 0")
+    if "chaos" in kwargs and (
+        kwargs.get("shared_prefix") or "spec_k" in kwargs
+        or kwargs.get("whole_prompt")
+    ):
+        raise SystemExit(
+            "--chaos runs its own serving pass; it does not compose "
+            "with --whole-prompt/--shared-prefix/--spec-k"
+        )
     if "dist_opt" in kwargs and which != "gpt":
         raise SystemExit("--dist-opt applies to the gpt bench")
     if "comm_dtype" in kwargs and which != "gpt":

@@ -20,7 +20,9 @@ CPU smoke:
 """
 
 import argparse
+import signal
 import sys
+import threading
 import time
 
 import jax
@@ -34,7 +36,33 @@ from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
 from rocm_apex_tpu.monitor import JsonlWriter, Tracer
 
 
+def _install_sigterm_drain() -> threading.Event:
+    """SIGTERM → graceful drain instead of a mid-tick kill.
+
+    Same shape as CheckpointManager's preemption hook: flip an Event
+    from the (async-signal-safe) handler and let the serving loop act
+    on it at the next tick boundary; chain any previously installed
+    handler so we compose with outer supervisors.
+    """
+    stop = threading.Event()
+    if threading.current_thread() is not threading.main_thread():
+        return stop  # signal.signal is main-thread-only
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def _handler(signum, frame):
+        stop.set()
+        if callable(prev):
+            prev(signum, frame)
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except (ValueError, OSError):
+        pass
+    return stop
+
+
 def main():
+    stop = _install_sigterm_drain()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--num-layers", type=int, default=2)
     p.add_argument("--hidden-size", type=int, default=64)
@@ -93,9 +121,12 @@ def main():
         x.size for x in jax.tree_util.tree_leaves(params)
     )
     chunked = args.token_budget > 0
+    # flush: supervisors watch this banner to know the serving loop
+    # (and its SIGTERM drain handler) is up, even through a pipe
     print(f"model: {n_params / 1e6:.1f}M params, "
           f"{jax.default_backend()} backend, "
-          f"prefill={'budget %d' % args.token_budget if chunked else 'whole-prompt'}")
+          f"prefill={'budget %d' % args.token_budget if chunked else 'whole-prompt'}",
+          flush=True)
 
     tracer = Tracer(enabled=args.trace is not None)
     eng = InferenceEngine(
@@ -123,10 +154,27 @@ def main():
     ]
 
     t0 = time.perf_counter()
-    results = eng.generate(prompts, max_new_tokens=args.max_new_tokens)
+    for prompt in prompts:
+        eng.add_request(prompt, args.max_new_tokens)
+    results = []
+    drained = False
+    while eng.has_work():
+        if stop.is_set():
+            # SIGTERM: shed the queue, let in-flight requests finish,
+            # exit 0 — never kill a request mid-token
+            results.extend(eng.drain(shed_queue=True))
+            drained = True
+            break
+        results.extend(eng.step())
+    results.sort(key=lambda r: r.request_id)
     dt = time.perf_counter() - t0
 
     n_gen = sum(len(r.tokens) for r in results)
+    if drained:
+        shed = sum(1 for r in results if r.finish_reason == "cancelled")
+        print(f"SIGTERM: drained gracefully — "
+              f"{len(results) - shed} requests completed, "
+              f"{shed} shed from the queue")
     for r in results:
         print(f"req {r.request_id}: prompt[{len(r.prompt)}] -> "
               f"{r.tokens} ({r.finish_reason})")
@@ -152,6 +200,8 @@ def main():
                 w.emit(rec)
         print(f"trace: {n} events -> {args.trace}; "
               f"{len(eng.completions)} request records -> {req_path}")
+    if drained:
+        return  # a drained run may stop before every program traced
     if chunked:
         # the fixed-shape contract: ONE mixed program for the whole
         # run regardless of the prompt mix (+ at most one decode-only

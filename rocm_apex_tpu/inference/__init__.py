@@ -17,6 +17,11 @@ nothing is forked:
                up to k continuation tokens per slot by suffix-matching
                the slot's own history (no draft model); pluggable hook
                protocol for learned drafters
+    faults     deterministic chaos harness: seeded `FaultPlan`
+               schedules (tick / nth-call / periodic / probabilistic)
+               over the engine's failure sites — page allocation,
+               device step, logits (NaN/Inf poisoning), host fetch —
+               with the shared `NO_FAULTS` null plan on the hot path
     engine     continuous-batching serving loop: fixed slot grid,
                request queue, per-step admit/evict, and the chunked-
                prefill token-budget scheduler — ONE compiled mixed
@@ -31,10 +36,17 @@ the cache layout and the serving loop. See docs/inference.md.
 
 from rocm_apex_tpu.inference.drafting import NGramDrafter  # noqa: F401
 from rocm_apex_tpu.inference.engine import (  # noqa: F401
+    FINISH_REASONS,
     GenerationResult,
     InferenceEngine,
     Request,
     SamplingParams,
+)
+from rocm_apex_tpu.inference.faults import (  # noqa: F401
+    NO_FAULTS,
+    Fault,
+    FaultInjected,
+    FaultPlan,
 )
 from rocm_apex_tpu.inference.kv_cache import KVCache  # noqa: F401
 from rocm_apex_tpu.inference.paging import (  # noqa: F401
@@ -56,6 +68,11 @@ __all__ = [
     "PrefixStore",
     "InferenceEngine",
     "NGramDrafter",
+    "Fault",
+    "FaultPlan",
+    "FaultInjected",
+    "NO_FAULTS",
+    "FINISH_REASONS",
     "Request",
     "GenerationResult",
     "SamplingParams",

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rocm_apex_tpu import profiler
+from rocm_apex_tpu.inference.faults import NO_FAULTS, FaultInjected
 from rocm_apex_tpu.inference.kv_cache import KVCache
 from rocm_apex_tpu.inference.paging import (
     PageAllocator,
@@ -70,7 +71,15 @@ __all__ = [
     "Request",
     "GenerationResult",
     "InferenceEngine",
+    "FINISH_REASONS",
 ]
+
+#: every finish_reason a `GenerationResult` can carry — the lifecycle
+#: contract documented in docs/inference.md "Failure semantics"
+FINISH_REASONS = (
+    "eos", "length", "capacity",  # normal completion paths
+    "deadline", "cancelled", "error", "queue_full",  # robustness paths
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,6 +100,12 @@ class Request:
     # enqueue wall time (perf_counter domain) — the anchor for the
     # queue-wait and TTFT percentiles in `stats()`
     enqueued_at: float = 0.0
+    # lifecycle bounds (absolute perf_counter times; None = unbounded):
+    # `deadline` is end-to-end (queue wait + serving), checked at tick
+    # boundaries; `queue_deadline` is the admission TTL — a request
+    # still queued past it is expired without ever taking a slot.
+    deadline: Optional[float] = None
+    queue_deadline: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -98,7 +113,7 @@ class GenerationResult:
     request_id: int
     prompt: List[int]
     tokens: List[int]  # generated ids (includes the eos when hit)
-    finish_reason: str  # "eos" | "length" | "capacity"
+    finish_reason: str  # one of FINISH_REASONS
 
 
 @dataclasses.dataclass
@@ -189,6 +204,25 @@ class InferenceEngine:
     Single-chip (tp=1) in this PR; the cache layout already stores
     LOCAL head shards, so multi-chip sharded serving is a cache-
     compatible follow-up.
+
+    Robustness layer (docs/inference.md "Failure semantics"): per-
+    request deadlines/queue TTLs (``add_request(timeout=, queue_ttl=)``,
+    checked at tick boundaries), `cancel`, `drain`, a bounded
+    admission queue (``max_queue`` — overflow sheds the NEWEST request
+    with a ``queue_full`` result, never silently), a stall watchdog
+    (``watchdog_timeout`` wall-seconds without token progress raises
+    with the stuck slots named; ``watchdog_dump_path`` persists the
+    engine state + tracer timeline first), device-step retry with
+    capped exponential backoff (``max_step_retries``/
+    ``step_retry_backoff``; exhaustion preempts-and-requeues the
+    in-flight batch before surfacing), and per-slot quarantine of
+    nonfinite logits (finish reason ``error``; ``flight_recorder``
+    dumps the anomaly bundle). ``faults`` accepts a seeded
+    `inference.faults.FaultPlan` — the chaos harness that injects
+    failures at the page-allocation / device-step / logits /
+    host-fetch sites deterministically; the default is the shared
+    ``NO_FAULTS`` null plan. Every transition is a host-side slot-mask
+    edit: ``mixed_trace_count`` stays 1 under any plan.
     """
 
     def __init__(
@@ -214,6 +248,13 @@ class InferenceEngine:
         spec_k: int = 0,
         drafter=None,
         spec_window: int = 64,
+        faults=None,
+        max_queue: Optional[int] = None,
+        max_step_retries: int = 2,
+        step_retry_backoff: float = 0.0,
+        watchdog_timeout: Optional[float] = None,
+        watchdog_dump_path: Optional[str] = None,
+        flight_recorder=None,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -366,6 +407,44 @@ class InferenceEngine:
         # per-request completion records (host-side; see `completions`)
         self._completions: List[Dict[str, float]] = []
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # ---- robustness layer (ISSUE 12) -----------------------------
+        # faults: the chaos harness (NO_FAULTS = the shared null plan —
+        # call sites pay one `enabled` attribute check, the NULL_TRACER
+        # idiom). All injection and all lifecycle transitions below are
+        # host-side slot-mask edits: the compiled programs never change
+        # shape and `mixed_trace_count` stays 1 under any plan.
+        self.faults = faults if faults is not None else NO_FAULTS
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        if max_step_retries < 0:
+            raise ValueError(
+                f"max_step_retries must be >= 0, got {max_step_retries}"
+            )
+        self.max_step_retries = int(max_step_retries)
+        self.step_retry_backoff = float(step_retry_backoff)
+        if watchdog_timeout is not None and watchdog_timeout <= 0:
+            raise ValueError(
+                f"watchdog_timeout must be > 0 seconds, got "
+                f"{watchdog_timeout}"
+            )
+        self.watchdog_timeout = watchdog_timeout
+        self.watchdog_dump_path = watchdog_dump_path
+        self.flight_recorder = flight_recorder
+        self._cancelled = 0
+        self._deadline_exceeded = 0
+        self._quarantined = 0
+        self._step_retries = 0
+        self._shed = 0
+        self._watchdog_fires = 0
+        self._draining = False
+        self._tick = 0  # step() count — the fault plans' tick domain
+        # queue_full results awaiting delivery through the next step()
+        self._shed_results: List[GenerationResult] = []
+        # stall watchdog anchors: last wall time token progress was
+        # observed, and the counter snapshot that defines "progress"
+        self._last_progress = time.perf_counter()
+        self._progress_mark = (0, 0, 0)
 
         sp = self.sampling
 
@@ -400,7 +479,15 @@ class InferenceEngine:
         is_paged = self.paged
         dev_capacity = self.cache.capacity
 
-        def _decode_body(params, cache, tokens, active, rng):
+        def _decode_body(params, cache, tokens, active, poison, rng):
+            # `poison` is a per-slot fp32 addend on the logits — zeros
+            # on the fault-free path (x + 0.0 leaves the greedy argmax
+            # and the sampling distribution untouched), NaN/Inf when
+            # the chaos harness poisons one slot. The per-slot
+            # nonfinite flag is computed IN-GRAPH and rides the same
+            # batched fetch as the sampled tokens, so fault isolation
+            # costs no extra device sync and no extra trace — it also
+            # catches a genuine model blow-up for free.
             lengths0 = cache.lengths
             if is_paged:
                 # dead rows write at the device capacity sentinel: the
@@ -427,17 +514,19 @@ class InferenceEngine:
                     active, new_cache.lengths, lengths0
                 )
             )
-            tok = _sample(rng, logits[:, -1, :])
-            return jnp.where(active, tok, 0), new_cache
+            last = logits[:, -1, :] + poison[:, None]
+            bad = jnp.any(~jnp.isfinite(last), axis=-1)
+            tok = _sample(rng, last)
+            return jnp.where(active, tok, 0), bad, new_cache
 
-        def _decode(params, cache, tokens, active, rng):
+        def _decode(params, cache, tokens, active, poison, rng):
             self._decode_traces += 1
-            return _decode_body(params, cache, tokens, active, rng)
+            return _decode_body(params, cache, tokens, active, poison, rng)
 
         def _mixed(
             params, cache, chunk_tokens, chunk_slots, chunk_pos,
             lengths_before, lengths_after, completion_idx,
-            dec_tokens, dec_active, rng,
+            dec_tokens, dec_active, chunk_poison, dec_poison, rng,
         ):
             """ONE compiled program per tick: packed prefill chunk +
             the whole decode grid. The host is the source of truth for
@@ -460,8 +549,13 @@ class InferenceEngine:
                 chunk=(chunk_slots, chunk_pos),
             )
             # sample EVERY chunk position (fixed shape); the host keeps
-            # only the positions that completed a prompt this tick
-            chunk_tok = _sample(rng_c, logits_c[0])
+            # only the positions that completed a prompt this tick.
+            # `chunk_poison` follows the decode-grid poison contract:
+            # zeros normally, NaN/Inf on a quarantine-test row — the
+            # per-row nonfinite flags share the tick's one fetch.
+            logits_p = logits_c[0] + chunk_poison[:, None]
+            chunk_bad = jnp.any(~jnp.isfinite(logits_p), axis=-1)
+            chunk_tok = _sample(rng_c, logits_p)
             # commit the chunk: cursors advance by what was packed
             cache = cache.replace(lengths=lengths_after)
             budget = chunk_tokens.shape[0]
@@ -471,15 +565,15 @@ class InferenceEngine:
             ]
             dec_tokens = jnp.where(has_comp, first_tok, dec_tokens)
             dec_active = dec_active | has_comp
-            dec_tok, cache = _decode_body(
-                params, cache, dec_tokens, dec_active, rng_d
+            dec_tok, dec_bad, cache = _decode_body(
+                params, cache, dec_tokens, dec_active, dec_poison, rng_d
             )
-            return chunk_tok, dec_tok, cache
+            return chunk_tok, dec_tok, chunk_bad, dec_bad, cache
 
         def _mixed_spec(
             params, cache, chunk_tokens, chunk_slots, chunk_pos,
             commit_slots, lengths_before, lengths_after, completion_idx,
-            dec_tokens, dec_active, rng,
+            dec_tokens, dec_active, chunk_poison, dec_poison, rng,
         ):
             """Speculative variant of `_mixed`: the chunk may carry,
             per decoding slot, that slot's last generated token plus up
@@ -508,7 +602,9 @@ class InferenceEngine:
             # IS the verifier's token — greedy accepts on equality,
             # and under temperature the sample-vs-draft equality test
             # is exact rejection sampling for a point-mass drafter
-            chunk_tok = _sample(rng_c, logits_c[0])
+            logits_p = logits_c[0] + chunk_poison[:, None]
+            chunk_bad = jnp.any(~jnp.isfinite(logits_p), axis=-1)
+            chunk_tok = _sample(rng_c, logits_p)
             cache = cache.replace(lengths=lengths_after)
             budget = chunk_tokens.shape[0]
             has_comp = completion_idx >= 0
@@ -517,10 +613,10 @@ class InferenceEngine:
             ]
             dec_tokens = jnp.where(has_comp, first_tok, dec_tokens)
             dec_active = dec_active | has_comp
-            dec_tok, cache = _decode_body(
-                params, cache, dec_tokens, dec_active, rng_d
+            dec_tok, dec_bad, cache = _decode_body(
+                params, cache, dec_tokens, dec_active, dec_poison, rng_d
             )
-            return chunk_tok, dec_tok, cache, chunk_kv
+            return chunk_tok, dec_tok, chunk_bad, dec_bad, cache, chunk_kv
 
         n_layers = len(self.cache.k)
 
@@ -585,7 +681,20 @@ class InferenceEngine:
         return self._mixed_traces
 
     def has_work(self) -> bool:
-        return bool(self._queue) or self.num_active > 0
+        return (
+            bool(self._queue) or self.num_active > 0
+            or bool(self._shed_results)
+        )
+
+    @property
+    def draining(self) -> bool:
+        """True once `drain()` was called: admission is closed."""
+        return self._draining
+
+    @property
+    def tick_count(self) -> int:
+        """Engine ticks so far — the `FaultPlan` tick domain."""
+        return self._tick
 
     @property
     def completions(self) -> List[Dict[str, float]]:
@@ -685,6 +794,16 @@ class InferenceEngine:
         }
         return {
             **paged_stats,
+            # robustness counters (docs/inference.md "Failure
+            # semantics"): every lifecycle transition is accounted —
+            # completed + shed + quarantined + cancelled + expired
+            # equals submitted, never a silent drop
+            "cancelled": float(self._cancelled),
+            "deadline_exceeded": float(self._deadline_exceeded),
+            "quarantined": float(self._quarantined),
+            "step_retries": float(self._step_retries),
+            "shed": float(self._shed),
+            "watchdog_fires": float(self._watchdog_fires),
             "tokens_drafted": float(self._tokens_drafted),
             "tokens_accepted": float(self._tokens_accepted),
             "acceptance_rate": (
@@ -741,6 +860,15 @@ class InferenceEngine:
         self._tokens_drafted = 0
         self._tokens_accepted = 0
         self._rollbacks = 0
+        self._cancelled = 0
+        self._deadline_exceeded = 0
+        self._quarantined = 0
+        self._step_retries = 0
+        self._shed = 0
+        self._watchdog_fires = 0
+        # the watchdog's progress snapshot tracks counters just zeroed
+        self._progress_mark = (0, 0, 0)
+        self._last_progress = time.perf_counter()
 
     def cache_bytes(self) -> int:
         """Device bytes held by the KV cache (pools/buffers + scales +
@@ -757,13 +885,34 @@ class InferenceEngine:
         prompt: Sequence[int],
         max_new_tokens: int,
         request_id: Optional[int] = None,
+        *,
+        timeout: Optional[float] = None,
+        queue_ttl: Optional[float] = None,
     ) -> int:
         """Queue a prompt; returns the request id. The request is
         admitted into a cache slot by a later `step` when a slot is
         free; its prompt then streams through the prefill budget. The
         only length bound is the physical cache: a prompt must fit in
         ``capacity`` rows. (The legacy whole-prompt path additionally
-        needs the prompt to fit its ``max_prompt_len`` pad width.)"""
+        needs the prompt to fit its ``max_prompt_len`` pad width.)
+
+        ``timeout`` (seconds) is the request's END-TO-END deadline —
+        queue wait included — and ``queue_ttl`` bounds the queue wait
+        alone; both are checked at tick boundaries and expire the
+        request with ``finish_reason='deadline'`` (in-flight work is
+        torn down through the ordinary eviction path, so pages and
+        slots are released correctly).
+
+        With ``max_queue`` set, a request arriving at a full queue is
+        SHED, never silently dropped: it still gets an id, a
+        ``queue_full`` result is delivered by the next `step()` (so
+        `generate` callers see it), and the ``shed`` counter ticks.
+        After `drain()` admission is closed and this raises."""
+        if self._draining:
+            raise RuntimeError(
+                "engine is draining: admission is closed "
+                "(drain() was called)"
+            )
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -781,12 +930,51 @@ class InferenceEngine:
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if timeout is not None and timeout <= 0:
+            raise ValueError(f"timeout must be > 0 s, got {timeout}")
+        if queue_ttl is not None and queue_ttl <= 0:
+            raise ValueError(f"queue_ttl must be > 0 s, got {queue_ttl}")
         if request_id is None:
             request_id = self._next_id
         self._next_id = max(self._next_id, request_id) + 1
+        now = time.perf_counter()
+        if (
+            self.max_queue is not None
+            and len(self._queue) >= self.max_queue
+        ):
+            # bounded admission: shed-NEWEST (the queued requests keep
+            # their positions — fairness under overload), accounted in
+            # the completion records and delivered as a queue_full
+            # result through the next step()
+            self._shed += 1
+            self._completions.append({
+                "request_id": request_id,
+                "finish_reason": "queue_full",
+                "prompt_tokens": len(prompt),
+                "new_tokens": 0,
+                "chunks": 0,
+                "queue_wait_ms": 0.0,
+                "ttft_ms": 0.0,
+                "tpot_ms": 0.0,
+                "e2e_ms": 0.0,
+            })
+            self._shed_results.append(GenerationResult(
+                request_id=request_id, prompt=prompt, tokens=[],
+                finish_reason="queue_full",
+            ))
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "shed", ts=now, track=f"req{request_id}",
+                    queue_depth=len(self._queue),
+                )
+            return request_id
         req = Request(
             request_id, prompt, max_new_tokens,
-            enqueued_at=time.perf_counter(),
+            enqueued_at=now,
+            deadline=(now + timeout) if timeout is not None else None,
+            queue_deadline=(
+                (now + queue_ttl) if queue_ttl is not None else None
+            ),
         )
         self._queue.append(req)
         if self.tracer.enabled:
@@ -805,10 +993,88 @@ class InferenceEngine:
         prefilling). Legacy mode: one compiled whole-prompt prefill
         per admit, then the decode step. Returns the requests that
         finished this tick (their slots are already free for the
-        next)."""
+        next) — including any shed (``queue_full``) and expired
+        (``deadline``) requests, so every submitted request yields
+        exactly one result."""
+        now = time.perf_counter()
+        self._check_watchdog(now)
+        out: List[GenerationResult] = []
+        if self._shed_results:
+            out.extend(self._shed_results)
+            self._shed_results = []
+        out.extend(self._expire_deadlines(now))
         if self.chunked:
-            return self._step_chunked()
-        return self._step_whole()
+            out.extend(self._step_chunked())
+        else:
+            out.extend(self._step_whole())
+        self._tick += 1
+        self._note_progress()
+        return out
+
+    def cancel(self, request_id: int) -> Optional[GenerationResult]:
+        """Cancel one request, wherever it is in its lifecycle, and
+        return its partial result (``finish_reason='cancelled'``, the
+        tokens generated so far) — or None if the id is unknown or
+        already finished. In-flight work tears down through the
+        ordinary eviction path, so the slot frees and its pages
+        release with the PR-7 allocator invariants intact (CoW
+        refcounts drop, store-registered prefix pages park). A
+        preempted request's carried tokens are returned too. Host
+        bookkeeping only — the compiled programs never see a cancel
+        (the next tick simply runs without the slot)."""
+        now = time.perf_counter()
+        for req in self._queue:
+            if req.request_id == request_id:
+                self._queue.remove(req)
+                self._cancelled += 1
+                return self._finalize_queued(req, "cancelled", now)
+        for slot, st in enumerate(self._slots):
+            if st is not None and st.req.request_id == request_id:
+                self._cancelled += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "cancel", ts=now, track=f"req{request_id}",
+                        slot=slot, generated=len(st.generated),
+                    )
+                return self._evict(slot, st, "cancelled")
+        return None
+
+    def drain(self, shed_queue: bool = False) -> List[GenerationResult]:
+        """Graceful shutdown: close admission (`add_request` raises
+        from here on), run the engine until all accepted work
+        finishes, and return those results. ``shed_queue=True``
+        additionally cancels the still-QUEUED requests up front
+        (finish_reason ``cancelled``) so only the in-flight slots run
+        to completion — the SIGTERM fast path. Stats counters and
+        tracer events are all emitted by the time this returns; the
+        caller flushes them (``stats()`` / ``export_chrome_trace``).
+        Bounded by the stall watchdog like any other stepping."""
+        self._draining = True
+        now = time.perf_counter()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "drain_begin", ts=now, track="engine",
+                queued=self.num_queued, active=self.num_active,
+            )
+        out: List[GenerationResult] = []
+        if shed_queue:
+            while self._queue:
+                req = self._queue.popleft()
+                self._cancelled += 1
+                out.append(self._finalize_queued(req, "cancelled", now))
+        while self.has_work():
+            out.extend(self.step())
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "drain_end", track="engine", finished=len(out),
+            )
+        return out
+
+    #: consecutive zero-progress ticks `generate()` tolerates before
+    #: diagnosing a stall (a backstop when no wall-clock watchdog is
+    #: configured; page-stall backpressure either recovers within a
+    #: tick or two or raises the pool-deadlock diagnosis long before)
+    _GENERATE_STALL_TICKS = 1000
 
     def generate(
         self,
@@ -816,12 +1082,36 @@ class InferenceEngine:
         max_new_tokens: int,
     ) -> List[GenerationResult]:
         """Convenience batch API: queue every prompt, run the serving
-        loop dry, return results in prompt order."""
+        loop dry, return results in prompt order. The loop is BOUNDED:
+        the engine's wall-clock watchdog (``watchdog_timeout``) fires
+        through `step()`, and even without one a run of
+        ``_GENERATE_STALL_TICKS`` consecutive ticks with no token
+        progress and nothing finished raises a diagnostic RuntimeError
+        naming the stuck slot(s) instead of spinning forever."""
         ids = [self.add_request(p, max_new_tokens) for p in prompts]
         done = {}
+        stale = 0
+        mark = (
+            self._prompt_tokens, self._generated_tokens, self._evicted,
+        )
         while self.has_work():
-            for r in self.step():
+            results = self.step()
+            for r in results:
                 done[r.request_id] = r
+            work = (
+                self._prompt_tokens, self._generated_tokens,
+                self._evicted,
+            )
+            if results or work != mark:
+                stale, mark = 0, work
+                continue
+            stale += 1
+            if stale >= self._GENERATE_STALL_TICKS:
+                raise RuntimeError(
+                    f"generate() stalled: {stale} consecutive ticks "
+                    f"without token progress; {self._stall_diagnosis()}"
+                    f" (set watchdog_timeout for a wall-clock bound)"
+                )
         return [done[i] for i in ids]
 
     # ------------------------------------------------------------------
@@ -854,6 +1144,12 @@ class InferenceEngine:
         False when the pool cannot supply a page: the caller
         backpressures (the token simply is not scheduled this tick;
         nothing crashes, nothing clamps)."""
+        if self.faults.enabled and self.faults.fire(
+            "page_alloc", tick=self._tick, slot=slot, page_idx=idx,
+        ) is not None:
+            # injected allocator failure: indistinguishable from a
+            # genuinely exhausted pool — the caller backpressures
+            return False
         sentinel = self.cache.num_pages
         page = int(self._table[slot, idx])
         track = f"req{st.req.request_id}"
@@ -1079,6 +1375,239 @@ class InferenceEngine:
                     track=f"req{req.request_id}", slot=slot,
                 )
 
+    # -- robustness internals ------------------------------------------
+
+    def _maybe_fail_fetch(self) -> None:
+        """The ``host_fetch`` fault site: between the device call and
+        the value fetch — the retry wrapper sees it like any other
+        transient failure."""
+        if self.faults.enabled and self.faults.fire(
+            "host_fetch", tick=self._tick,
+        ) is not None:
+            raise FaultInjected(
+                f"injected host_fetch fault (tick {self._tick})"
+            )
+
+    def _call_device(self, thunk):
+        """Run one compiled step (+ its fetch) with the ``device_step``
+        fault site and capped exponential-backoff retry. ``thunk``
+        performs the jitted call and the fetch and RETURNS the new
+        cache instead of assigning it — `self.cache` only moves
+        forward on success, so a retry re-runs against the pre-step
+        cache and the rng split already made (bitwise-deterministic
+        recovery on CPU, where buffers are not donated; on TPU a
+        genuine mid-step failure consumes the donated cache and the
+        retry surfaces that — the requeue path below still runs).
+        On exhaustion every in-flight slot preempts-and-requeues via
+        the PR-8 path, then the failure propagates."""
+        attempt = 0
+        while True:
+            try:
+                if self.faults.enabled and self.faults.fire(
+                    "device_step", tick=self._tick,
+                ) is not None:
+                    raise FaultInjected(
+                        f"injected device_step fault (tick {self._tick})"
+                    )
+                return thunk()
+            except Exception:
+                if attempt >= self.max_step_retries:
+                    self._requeue_in_flight()
+                    raise
+                attempt += 1
+                self._step_retries += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "step_retry", track="engine", attempt=attempt,
+                    )
+                if self.step_retry_backoff > 0:
+                    time.sleep(min(
+                        self.step_retry_backoff * (2 ** (attempt - 1)),
+                        1.0,
+                    ))
+
+    def _requeue_in_flight(self) -> None:
+        """Device-step retries exhausted: hand every in-flight request
+        back to the queue through the PR-8 preempt path before the
+        failure surfaces, so a caller that catches it finds a
+        consistent engine (slots free, pages released, requests
+        queued) and the next successful tick recomputes everything.
+        Reverse slot order + appendleft keeps the original slot order
+        at the queue head. Pages registered in the prefix store by
+        COMPLETED ticks are valid and park as usual; the failed
+        tick's writes never registered (registration is deferred past
+        the device call) so no junk page can be matched later."""
+        for slot in range(self.num_slots - 1, -1, -1):
+            st = self._slots[slot]
+            if st is None:
+                continue
+            if self.paged:
+                self._release_slot_pages(st, slot)
+            self._slots[slot] = None
+            if st.generated:
+                self._preempted[st.req.request_id] = (
+                    list(st.generated), st.first_token_at, st.chunks,
+                )
+            self._queue.appendleft(st.req)
+            self._preemptions += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "requeue", track=f"req{st.req.request_id}",
+                    slot=slot, generated=len(st.generated),
+                )
+        if self.paged:
+            self._push_table()
+
+    def _expire_deadlines(self, now: float) -> List[GenerationResult]:
+        """Tick-boundary deadline sweep: queued requests past their
+        queue TTL or end-to-end deadline expire without a slot;
+        in-flight requests past their deadline tear down through the
+        ordinary eviction (slot + pages released). Both finish with
+        reason ``deadline``."""
+        out: List[GenerationResult] = []
+        if self._queue:
+            keep: collections.deque = collections.deque()
+            for req in self._queue:
+                expired = (
+                    (req.queue_deadline is not None
+                     and now > req.queue_deadline)
+                    or (req.deadline is not None and now > req.deadline)
+                )
+                if expired:
+                    self._deadline_exceeded += 1
+                    out.append(
+                        self._finalize_queued(req, "deadline", now)
+                    )
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for slot, st in enumerate(self._slots):
+            if st is None or st.req.deadline is None:
+                continue
+            if now > st.req.deadline:
+                self._deadline_exceeded += 1
+                out.append(self._evict(slot, st, "deadline"))
+        return out
+
+    def _finalize_queued(
+        self, req: Request, reason: str, now: float
+    ) -> GenerationResult:
+        """Finish a request that never (re)took a slot — expired in
+        queue, cancelled in queue, or shed by drain. A PREEMPTED
+        request waiting to resume returns the tokens it already
+        generated (they were delivered work; dropping them would
+        un-deliver it)."""
+        carried = self._preempted.pop(req.request_id, None)
+        tokens = list(carried[0]) if carried is not None else []
+        self._completions.append({
+            "request_id": req.request_id,
+            "finish_reason": reason,
+            "prompt_tokens": len(req.prompt),
+            "new_tokens": len(tokens),
+            "chunks": carried[2] if carried is not None else 0,
+            "queue_wait_ms": 1e3 * (now - req.enqueued_at),
+            "ttft_ms": 0.0,
+            "tpot_ms": 0.0,
+            "e2e_ms": 1e3 * (now - req.enqueued_at),
+        })
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "finish", ts=now, track=f"req{req.request_id}",
+                reason=reason,
+            )
+        return GenerationResult(
+            request_id=req.request_id, prompt=list(req.prompt),
+            tokens=tokens, finish_reason=reason,
+        )
+
+    def _quarantine(
+        self, slot: int, st: _Slot, why: str
+    ) -> GenerationResult:
+        """Fault isolation: nonfinite logits on ONE slot evict that
+        slot only (``finish_reason='error'``) — the tick's other slots
+        already got their tokens from the same fetch, bitwise
+        identical to a fault-free run (the poison/flag path adds
+        ``+0.0`` to their logits and nothing else). The flight
+        recorder, when wired, dumps a ``nonfinite/slot<i>`` bundle for
+        the postmortem."""
+        self._quarantined += 1
+        rid = st.req.request_id
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "quarantine", track=f"req{rid}", slot=slot, why=why,
+            )
+        if self.flight_recorder is not None:
+            self.flight_recorder.record(
+                self._tick, {f"nonfinite/slot{slot}": 1.0},
+                request_id=rid, pos=st.pos,
+                generated=len(st.generated),
+            )
+        return self._evict(slot, st, "error")
+
+    def _note_progress(self) -> None:
+        """Token progress = prompt tokens absorbed, tokens generated,
+        or slots evicted — the signals the stall watchdog watches."""
+        work = (
+            self._prompt_tokens, self._generated_tokens, self._evicted,
+        )
+        if work != self._progress_mark:
+            self._progress_mark = work
+            self._last_progress = time.perf_counter()
+
+    def _check_watchdog(self, now: float) -> None:
+        if self.watchdog_timeout is None or not self.has_work():
+            return
+        stalled = now - self._last_progress
+        if stalled <= self.watchdog_timeout:
+            return
+        self._watchdog_fires += 1
+        diag = self._stall_diagnosis()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "watchdog", track="engine", stalled_seconds=stalled,
+            )
+        if self.watchdog_dump_path is not None:
+            # the postmortem bundle: engine state as json, plus the
+            # tracer timeline next to it when tracing is on
+            import json
+
+            with open(self.watchdog_dump_path, "w") as f:
+                json.dump({
+                    "event": "watchdog",
+                    "stalled_seconds": stalled,
+                    "tick": self._tick,
+                    "diagnosis": diag,
+                    "stats": self.stats(),
+                }, f, indent=2)
+            if self.tracer.enabled:
+                self.tracer.export_chrome_trace(
+                    self.watchdog_dump_path + ".trace.json"
+                )
+        raise RuntimeError(
+            f"serving watchdog: no token progress for {stalled:.2f}s "
+            f"(watchdog_timeout={self.watchdog_timeout}s); {diag}"
+        )
+
+    def _stall_diagnosis(self) -> str:
+        """Name the stuck slot(s) — the diagnostic the watchdog and
+        the bounded `generate()` raise with."""
+        parts = []
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            phase = "prefilling" if st.prefilling else "decoding"
+            parts.append(
+                f"slot {slot}: request {st.req.request_id} {phase} "
+                f"pos={st.pos} cursor={st.cursor}/{len(st.prefix)} "
+                f"generated={len(st.generated)}"
+            )
+        if not parts:
+            parts.append("no slots leased")
+        return (
+            f"queue_depth={self.num_queued}, "
+            f"draining={self._draining}; " + "; ".join(parts)
+        )
+
     def _step_chunked(self) -> List[GenerationResult]:
         finished: List[GenerationResult] = []
         now = time.perf_counter()
@@ -1097,9 +1626,32 @@ class InferenceEngine:
         commit_slots = np.full((budget,), S, np.int32)
         lengths_before = np.zeros((S,), np.int32)
         lengths_after = np.zeros((S,), np.int32)
+        # logits poison: zeros on the fault-free path (the compiled
+        # programs add it unconditionally — x + 0.0 — so the fault-free
+        # tokens are bitwise identical and the trace never changes);
+        # a `logits` fault poisons ONE slot's rows with NaN/Inf
+        chunk_poison = np.zeros((budget,), np.float32)
+        dec_poison = np.zeros((S,), np.float32)
+        poison_slot = -1
+        poison_val = 0.0
+        if self.faults.enabled:
+            flt = self.faults.fire("logits", tick=self._tick)
+            if flt is not None:
+                pay = (
+                    flt.payload if isinstance(flt.payload, dict)
+                    else {"slot": flt.payload}
+                )
+                s = pay.get("slot")
+                poison_slot = int(s) if s is not None else 0
+                poison_val = float(pay.get("value", float("nan")))
+                if 0 <= poison_slot < S:
+                    dec_poison[poison_slot] = poison_val
         # (slot, chunk index of last prompt token, fed-to-decode flag)
         completions = []
         packed = []  # (slot, tokens, start_pos) — tracer span payload
+        # paged prefix registration deferred past the device call (a
+        # failed step must not leave never-written pages registered)
+        reg_pending = []
         # speculative bookkeeping: (slot, first chunk row, drafted
         # count, draft tokens, pre-draft position)
         spec_entries = []
@@ -1164,7 +1716,7 @@ class InferenceEngine:
                 lengths_after[slot] = st.cursor
                 self._prompt_tokens += n
                 if self.paged and self._store is not None:
-                    self._register_full_pages(st, slot)
+                    reg_pending.append((st, slot))
                 if not st.prefilling and not st.resumed:
                     # the completing prompt's first sampled token is
                     # fed straight into the fused decode — UNLESS that
@@ -1223,6 +1775,12 @@ class InferenceEngine:
             self._tokens_drafted += n
             used += n + 1
 
+        if poison_slot >= 0:
+            # poison the faulted slot's chunk rows too (a prompt
+            # completion or speculative span must quarantine the same
+            # way a decode row does)
+            chunk_poison[chunk_slots == poison_slot] = poison_val
+
         # decode grid: slots whose prompt completed in an EARLIER tick
         # (a slot finishing prefill this tick gets its first token from
         # the chunk logits below and starts decoding next tick; a slot
@@ -1275,6 +1833,8 @@ class InferenceEngine:
 
         chunk_out = None
         dec_out = None
+        chunk_bad = None
+        dec_bad = None
         chunk_kv = None
         spec_t0 = spec_t1 = 0.0
         if self.spec_k > 0 and (used > 0 or active.any()):
@@ -1286,12 +1846,9 @@ class InferenceEngine:
             # mixed_trace_count == 1 at any k
             self._rng, rng = jax.random.split(self._rng)
             t0 = time.perf_counter()
-            with profiler.annotate(
-                "inference/mixed_step",
-                chunk_tokens=used, decodes=int(active.sum()),
-                drafted=sum(e[2] for e in spec_entries),
-            ):
-                chunk_tok, dec_tok, self.cache, chunk_kv = (
+
+            def _spec_thunk():
+                chunk_tok, dec_tok, cbad, dbad, cache, kv = (
                     self._mixed_spec_jit(
                         self.params, self.cache,
                         jnp.asarray(chunk_tokens),
@@ -1302,12 +1859,29 @@ class InferenceEngine:
                         jnp.asarray(lengths_after),
                         jnp.asarray(completion_idx),
                         jnp.asarray(dec_tokens),
-                        jnp.asarray(active), rng,
+                        jnp.asarray(active),
+                        jnp.asarray(chunk_poison),
+                        jnp.asarray(dec_poison), rng,
                     )
                 )
-            # ONE batched value fetch per tick (= the device sync);
-            # chunk_kv stays on device for the commit program
-            chunk_out, dec_out = jax.device_get((chunk_tok, dec_tok))
+                self._maybe_fail_fetch()
+                # ONE batched value fetch per tick (= the device
+                # sync); chunk_kv stays on device for the commit
+                # program. The nonfinite flags ride the same fetch.
+                fetched = jax.device_get(
+                    (chunk_tok, dec_tok, cbad, dbad)
+                )
+                return fetched, cache, kv
+
+            with profiler.annotate(
+                "inference/mixed_step",
+                chunk_tokens=used, decodes=int(active.sum()),
+                drafted=sum(e[2] for e in spec_entries),
+            ):
+                fetched, self.cache, chunk_kv = self._call_device(
+                    _spec_thunk
+                )
+            chunk_out, dec_out, chunk_bad, dec_bad = fetched
             t1 = time.perf_counter()
             spec_t0, spec_t1 = t0, t1
             if prefill_used > 0:
@@ -1333,21 +1907,31 @@ class InferenceEngine:
         elif used > 0:
             self._rng, rng = jax.random.split(self._rng)
             t0 = time.perf_counter()
-            with profiler.annotate(
-                "inference/mixed_step",
-                chunk_tokens=used, decodes=int(active.sum()),
-            ):
-                chunk_tok, dec_tok, self.cache = self._mixed_jit(
+
+            def _mixed_thunk():
+                chunk_tok, dec_tok, cbad, dbad, cache = self._mixed_jit(
                     self.params, self.cache,
                     jnp.asarray(chunk_tokens), jnp.asarray(chunk_slots),
                     jnp.asarray(chunk_pos), jnp.asarray(lengths_before),
                     jnp.asarray(lengths_after),
                     jnp.asarray(completion_idx), jnp.asarray(dec_tokens),
-                    jnp.asarray(active), rng,
+                    jnp.asarray(active), jnp.asarray(chunk_poison),
+                    jnp.asarray(dec_poison), rng,
                 )
-            # ONE batched value fetch per tick (= the device sync) —
-            # never a per-request scalar pull
-            chunk_out, dec_out = jax.device_get((chunk_tok, dec_tok))
+                self._maybe_fail_fetch()
+                # ONE batched value fetch per tick (= the device sync)
+                # — never a per-request scalar pull; the nonfinite
+                # flags ride the same fetch
+                return jax.device_get(
+                    (chunk_tok, dec_tok, cbad, dbad)
+                ), cache
+
+            with profiler.annotate(
+                "inference/mixed_step",
+                chunk_tokens=used, decodes=int(active.sum()),
+            ):
+                fetched, self.cache = self._call_device(_mixed_thunk)
+            chunk_out, dec_out, chunk_bad, dec_bad = fetched
             t1 = time.perf_counter()
             self._prefill_seconds += t1 - t0
             self._mixed_steps += 1
@@ -1368,14 +1952,21 @@ class InferenceEngine:
         elif active.any():
             self._rng, rng = jax.random.split(self._rng)
             t0 = time.perf_counter()
+
+            def _decode_thunk():
+                tok, bad, cache = self._decode_jit(
+                    self.params, self.cache, jnp.asarray(dec_tokens),
+                    jnp.asarray(active), jnp.asarray(dec_poison), rng,
+                )
+                self._maybe_fail_fetch()
+                # value fetch = device sync
+                return jax.device_get((tok, bad)), cache
+
             with profiler.annotate(
                 "inference/decode", batch=int(active.sum())
             ):
-                tok, self.cache = self._decode_jit(
-                    self.params, self.cache, jnp.asarray(dec_tokens),
-                    jnp.asarray(active), rng,
-                )
-            dec_out = np.asarray(tok)  # value fetch = device sync
+                fetched, self.cache = self._call_device(_decode_thunk)
+            dec_out, dec_bad = fetched
             t1 = time.perf_counter()
             self._decode_seconds += t1 - t0
             self._decode_steps += 1
@@ -1385,9 +1976,22 @@ class InferenceEngine:
                     decodes=int(active.sum()),
                 )
 
+        # the device step committed: NOW the tick's full prompt pages
+        # may register in the prefix store (see reg_pending above)
+        for st, slot in reg_pending:
+            self._register_full_pages(st, slot)
+
         now2 = time.perf_counter()
         for slot, idx, fed in completions:
             st = self._slots[slot]
+            if chunk_bad is not None and chunk_bad[idx]:
+                # fault isolation: only THIS slot quarantines; every
+                # other slot's tokens came out of the same fetch,
+                # bitwise identical to a fault-free tick
+                finished.append(self._quarantine(
+                    slot, st, "nonfinite logits at prompt completion",
+                ))
+                continue
             st.generated.append(int(chunk_out[idx]))
             self._generated_tokens += 1
             st.first_token_at = now2
@@ -1403,6 +2007,11 @@ class InferenceEngine:
                 # already evicted above, or a paged page stall): the
                 # second token arrives on a later tick
                 continue
+            if dec_bad is not None and dec_bad[slot]:
+                finished.append(self._quarantine(
+                    slot, st, "nonfinite logits in fused decode",
+                ))
+                continue
             # the mixed step fed the first token straight into the
             # decode grid: the SECOND token arrives in the same tick
             # (the whole-prompt admit-tick cadence, without the pad)
@@ -1415,6 +2024,11 @@ class InferenceEngine:
         if dec_out is not None:
             for slot, st in enumerate(self._slots):
                 if st is None or not active[slot]:
+                    continue
+                if dec_bad is not None and dec_bad[slot]:
+                    finished.append(self._quarantine(
+                        slot, st, "nonfinite logits in decode",
+                    ))
                     continue
                 st.pos += 1  # the input token was written this step
                 st.generated.append(int(dec_out[slot]))
@@ -1439,6 +2053,17 @@ class InferenceEngine:
             commit_pos_np = np.zeros((budget,), np.int32)
             for slot, r0, n, drafts, pos0 in spec_entries:
                 st = self._slots[slot]
+                if chunk_bad is not None and chunk_bad[
+                    r0:r0 + n + 1
+                ].any():
+                    # the whole span's K/V stays uncommitted (rollback
+                    # = "never written"), so quarantining the slot
+                    # cannot leave poisoned rows in shared pages
+                    finished.append(self._quarantine(
+                        slot, st,
+                        "nonfinite logits in speculative span",
+                    ))
+                    continue
                 out = chunk_out[r0:r0 + n + 1]
                 m = 0
                 while m < n and int(out[m]) == drafts[m]:
@@ -1580,22 +2205,32 @@ class InferenceEngine:
             )
             self._rng, rng = jax.random.split(self._rng)
             t0 = time.perf_counter()
+            poison = np.zeros((self.num_slots,), np.float32)
             with profiler.annotate(
                 "inference/decode", batch=int(active.sum())
             ):
-                tok, self.cache = self._decode_jit(
+                tok, bad, self.cache = self._decode_jit(
                     self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(active), rng,
+                    jnp.asarray(active), jnp.asarray(poison), rng,
                 )
-            toks = np.asarray(tok)  # value fetch = device sync
+            # value fetch = device sync
+            toks, bad_h = jax.device_get((tok, bad))
             self._decode_seconds += time.perf_counter() - t0
             self._decode_steps += 1
-            self._generated_tokens += int(active.sum())
             for slot, state in enumerate(self._slots):
                 if state is None:
                     continue
+                if bad_h[slot]:
+                    # a genuine model blow-up on one slot quarantines
+                    # it on the legacy path too (the chaos harness's
+                    # injection sites thread the chunked scheduler)
+                    finished.append(self._quarantine(
+                        slot, state, "nonfinite logits in decode",
+                    ))
+                    continue
                 state.pos += 1  # the input token was written this step
                 state.generated.append(int(toks[slot]))
+                self._generated_tokens += 1
                 done = self._finish_reason(state)
                 if done is not None:
                     finished.append(self._evict(slot, state, done))
@@ -1625,6 +2260,10 @@ class InferenceEngine:
         finished_at = time.perf_counter()
         req = state.req
         n_new = len(state.generated)
+        # a request torn down BEFORE its first token (cancel/deadline/
+        # quarantine mid-prefill) has no TTFT anchor — clamp to the
+        # teardown time so the record stays sane
+        first_at = state.first_token_at or finished_at
         # the jsonl-ready per-request completion record: the same
         # perf_counter anchors the tracer spans and `stats()` use, so
         # the three reports can never disagree about one request
@@ -1635,9 +2274,9 @@ class InferenceEngine:
             "new_tokens": n_new,
             "chunks": state.chunks,
             "queue_wait_ms": 1e3 * (state.leased_at - req.enqueued_at),
-            "ttft_ms": 1e3 * (state.first_token_at - req.enqueued_at),
+            "ttft_ms": 1e3 * (first_at - req.enqueued_at),
             "tpot_ms": (
-                1e3 * (finished_at - state.first_token_at)
+                1e3 * (finished_at - first_at)
                 / max(n_new - 1, 1)
             ),
             "e2e_ms": 1e3 * (finished_at - req.enqueued_at),
@@ -1645,7 +2284,7 @@ class InferenceEngine:
         if self.tracer.enabled:
             track = f"req{req.request_id}"
             self.tracer.add_span(
-                "decode", state.first_token_at, finished_at,
+                "decode", first_at, finished_at,
                 track=track, tokens=n_new, slot=slot,
             )
             self.tracer.instant(

@@ -137,6 +137,48 @@ class PageAllocator:
     def refcount(self, page: int) -> int:
         return self._ref[page]
 
+    def snapshot(self) -> Dict[str, int]:
+        """Counters for leak checks: a drained engine must return to
+        the baseline snapshot (every page free or parked, no refs)."""
+        return {
+            "free": len(self._free),
+            "parked": len(self._parked),
+            "available": self.available,
+            "refs": sum(self._ref),
+        }
+
+    def assert_consistent(self) -> None:
+        """The PR-7 allocator invariants, as one assertable check —
+        the robustness tests run it after EVERY teardown path
+        (cancel, deadline, quarantine, preempt, requeue, drain):
+
+        * free, parked, and referenced pages partition the pool
+          (no page in two states, none lost);
+        * no parked or free page holds a reference;
+        * no referenced page sits on the free list or the parked LRU.
+
+        Raises AssertionError naming the corrupted page otherwise."""
+        free = set(self._free)
+        parked = set(self._parked)
+        assert len(free) == len(self._free), (
+            f"free list holds duplicates: {sorted(self._free)}"
+        )
+        assert not (free & parked), (
+            f"pages both free and parked: {sorted(free & parked)}"
+        )
+        for page in range(self.num_pages):
+            refs = self._ref[page]
+            assert refs >= 0, f"page {page} has negative refs ({refs})"
+            if page in free or page in parked:
+                assert refs == 0, (
+                    f"page {page} is free/parked with refs={refs}"
+                )
+            else:
+                assert refs > 0, (
+                    f"page {page} leaked: not free, not parked, "
+                    f"refs=0"
+                )
+
 
 class _StoreEntry:
     __slots__ = ("key", "parent", "tokens", "page")

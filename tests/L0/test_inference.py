@@ -556,7 +556,9 @@ class TestChunkedPrefill:
             jnp.zeros((B,), i32), jnp.full((B,), S, i32),
             jnp.zeros((B,), i32), jnp.zeros((S,), i32),
             jnp.zeros((S,), i32), jnp.full((S,), -1, i32),
-            jnp.zeros((S,), i32), jnp.zeros((S,), bool), rng,
+            jnp.zeros((S,), i32), jnp.zeros((S,), bool),
+            jnp.zeros((B,), jnp.float32), jnp.zeros((S,), jnp.float32),
+            rng,
         )
         h, v = cfg.hidden_size, cfg.vocab_size
         report = assert_no_intermediate(

@@ -319,7 +319,9 @@ class TestSpecAudit:
             jnp.zeros((B,), i32), jnp.full((B,), S, i32),
             jnp.zeros((S,), i32), jnp.zeros((S,), i32),
             jnp.full((S,), -1, i32), jnp.zeros((S,), i32),
-            jnp.zeros((S,), bool), jax.random.PRNGKey(0),
+            jnp.zeros((S,), bool),
+            jnp.zeros((B,), jnp.float32), jnp.zeros((S,), jnp.float32),
+            jax.random.PRNGKey(0),
         )
         h, v = cfg.hidden_size, cfg.vocab_size
         report = assert_no_intermediate(

@@ -113,6 +113,102 @@ def test_example_runs(script, args):
     )
 
 
+def test_generate_gpt_sigterm_drains_gracefully():
+    """SIGTERM mid-run must drain the serving loop — shed the queue,
+    finish anything in flight, exit 0 — not die mid-tick (ISSUE 12).
+    The workload is far too large to finish on its own, so a plain
+    exit 0 here can only mean the drain path ran."""
+    import signal
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, str(REPO / "examples" / "generate_gpt.py"),
+            "--num-layers", "2", "--hidden-size", "64",
+            "--num-attention-heads", "4", "--max-seq-len", "64",
+            "--max-prompt-len", "12", "--num-slots", "2",
+            "--num-requests", "64", "--max-new-tokens", "48",
+            "--token-budget", "5",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=str(REPO),
+        env=ENV,
+    )
+    try:
+        # the "model:" banner prints after the SIGTERM handler is
+        # installed and before the serving loop starts
+        for line in proc.stdout:
+            if line.startswith("model:"):
+                proc.send_signal(signal.SIGTERM)
+                break
+        else:
+            pytest.fail("generate_gpt.py exited before its banner")
+        out, _ = proc.communicate(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, f"non-zero exit under SIGTERM\n{out[-2000:]}"
+    assert "SIGTERM: drained gracefully" in out
+    # every submitted request is accounted for — completed or shed
+    assert "(cancelled)" in out or "(length)" in out
+
+
+# slow: three full subprocess runs (~45 s) — excluded from the tier-1
+# gate per the marker's charter (pyproject.toml) to keep the suite
+# inside its hard wall-clock budget; deeper CI tiers and `-m slow`
+# runs still execute it
+@pytest.mark.slow
+def test_gpt_train_kill_and_resume_bitwise(tmp_path):
+    """ISSUE-12 acceptance bar: kill-and-resume training is BITWISE.
+
+    Run A trains 4 iters straight. Run B trains 2 iters and exits (a
+    stand-in for preemption — the SIGTERM path saves the same tree);
+    run C resumes from B's checkpoint and finishes. The full-state
+    sha256 the script prints covers fp32 masters, Adam moments (the
+    1/dp ZeRO shards under --dist-opt, whose int8-comm error-feedback
+    residuals live implicitly in master-vs-param deltas), and the
+    loss-scaler counters — A and C must match exactly."""
+    base = [
+        sys.executable, str(REPO / "examples" / "gpt_train.py"),
+        "--num-layers", "2", "--hidden-size", "64",
+        "--num-attention-heads", "4", "--seq-length", "32",
+        "--max-position-embeddings", "32", "--micro-batch-size", "2",
+        "--log-interval", "1",
+        # the hardest state to round-trip: TP=2 x DP=4 ZeRO shards
+        # with int8 ring collectives
+        "--tensor-model-parallel-size", "2", "--dist-opt",
+        "--comm-dtype", "int8",
+    ]
+
+    def run(iters, ckpt_dir):
+        out = subprocess.run(
+            [*base, "--train-iters", str(iters),
+             "--checkpoint-dir", str(ckpt_dir)],
+            capture_output=True, text=True, cwd=str(REPO), env=ENV,
+            timeout=900,
+        )
+        assert out.returncode == 0, (
+            f"stdout:\n{out.stdout[-2000:]}\nstderr:\n{out.stderr[-2000:]}"
+        )
+        digests = [
+            l for l in out.stdout.splitlines()
+            if l.startswith("state digest: ")
+        ]
+        assert len(digests) == 1
+        return digests[0], out.stderr
+
+    straight, _ = run(4, tmp_path / "a")
+    interrupted, _ = run(2, tmp_path / "b")
+    resumed, err = run(4, tmp_path / "b")
+    assert "resumed" in err and "at iter 2" in err
+    assert interrupted != straight  # 2 iters really is partial state
+    assert resumed == straight, (
+        "kill-and-resume diverged from the uninterrupted run"
+    )
+
+
 def test_imagenet_real_data_loader(tmp_path):
     """--data-dir drives the REAL input pipeline (ImageFolder scan ->
     worker decode -> native fast_collate -> prefetch + device_put)
