@@ -920,6 +920,17 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     )
 
 
+def _lint_head_is_chunked(cfg, batch: int, seq: int) -> bool:
+    """True when the fused LM head really tiles (b·s, vocab): with few
+    rows the op's default chunk covers them all and the single tile IS
+    logits-shaped by design, so the no-materialization probe would
+    flag a non-violation."""
+    from rocm_apex_tpu.ops.linear_xentropy import _chunk_rows
+
+    rows = batch * seq
+    return _chunk_rows(rows, cfg.vocab_size, cfg.lm_head_chunk_size) < rows
+
+
 def _timed_scan(step, init, iters):
     """ms per iteration of `step` (carry -> carry) inside one dispatch.
 
@@ -1201,7 +1212,7 @@ def bench_ln():
 def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
          remat: bool = False, loss: str = "fused",
          seq_parallel: bool = False, collective_matmul: bool = False,
-         audit: bool = False, dist_opt: bool = False,
+         audit: bool = False, lint: bool = False, dist_opt: bool = False,
          packed_update: bool = False, comm_dtype: str = "fp32"):
     if loss not in ("fused", "naive"):
         raise SystemExit(f"--loss must be 'fused' or 'naive', got {loss!r}")
@@ -1228,6 +1239,11 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
             "--packed-update A/Bs the replicated optimizer step; the "
             "ZeRO path (--dist-opt) is always packed and the tp series "
             "keys on the model sharding"
+        )
+    if lint and dist_opt:
+        raise SystemExit(
+            "--lint checks the replicated train step; the ZeRO path's "
+            "contracts live in tools/graphlint.py (zero_int8 config)"
         )
     on_tpu = jax.default_backend() == "tpu"
     # tp-axis A/B: shard the model over ALL visible chips on the
@@ -1358,13 +1374,19 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
             )
             return params, ostate, rng, losses
 
+        # params/ostate are DONATED: the scan consumes and returns them,
+        # so the executable updates in place instead of holding both
+        # generations live (the donation lint pins this). Only metadata
+        # reads of params32 (`.size` for the param count) happen after
+        # the first call — those survive buffer deletion.
         runN_z = jax.jit(
             shard_map(
                 local_runN_zero, mesh=dmesh,
                 in_specs=(P(), P(), P(), P("data"), P("data")),
                 out_specs=(P(), P(), P(), P()),
                 check_rep=False,
-            )
+            ),
+            donate_argnums=(0, 1),
         )
         rng0 = _dropout_rng0(dropout, on_tpu)
         params_z, ostate, rng0, losses = runN_z(
@@ -1519,6 +1541,13 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         )
         return state, sstate, rng, losses
 
+    # (state, sstate) are DONATED into the loop: the optimizer carry is
+    # the largest resident buffer set in the program and an un-donated
+    # step holds two generations of it live (the donation lint pins
+    # this). state.master ALIASES params32 (fp32→fp32 astype is a
+    # no-copy view), so every VALUE read of params32 must happen before
+    # the first runN call — see the hoist block below; `.size`-only
+    # metadata reads survive buffer deletion.
     if mesh is not None:
         runN = jax.jit(
             shard_map(
@@ -1526,10 +1555,11 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
                 in_specs=(P(), P(), P()),
                 out_specs=(P(), P(), P(), P()),
                 check_rep=False,
-            )
+            ),
+            donate_argnums=(0, 1),
         )
     else:
-        runN = jax.jit(local_runN)
+        runN = jax.jit(local_runN, donate_argnums=(0, 1))
 
     if audit:
         # static program audit (monitor/audit.py): trace ONE train step
@@ -1552,6 +1582,84 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         report = monitor.audit(target, state, sstate, rng0)
         print("audit: one gpt train step", file=sys.stderr)
         print(report.summary(), file=sys.stderr)
+
+    if lint:
+        # graph-contract lint (monitor/lint.py): the train-step ruleset
+        # on ONE abstractly traced step — precision policy for the
+        # active compute dtype, no materialized (b·s, vocab) logits on
+        # the fused-head path (--loss=naive fails this by design: the
+        # naive reference IS the materialization), donated carries,
+        # trace stability. Exit 1 on any violation.
+        def _one_lint(state, sstate, rng):
+            (state, sstate, rng), scaled = one_step(
+                (state, sstate, rng), None
+            )
+            return state, sstate, scaled
+
+        target = _one_lint
+        if mesh is not None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            target = shard_map(
+                _one_lint, mesh=mesh, in_specs=(P(), P(), P()),
+                out_specs=(P(), P(), P()), check_rep=False,
+            )
+        subject = monitor.LintSubject.from_fn(
+            "gpt_train_step", target, state, sstate, rng0,
+            donate_argnums=(0, 1),
+        )
+        rules = [
+            monitor.PrecisionPolicy(
+                compute_dtype=str(jnp.dtype(cfg.dtype))
+            ),
+            monitor.NoMaterialization(
+                forbidden_shapes=((batch * seq, cfg.vocab_size),)
+                if loss == "fused" and _lint_head_is_chunked(cfg, batch, seq)
+                else ()
+            ),
+            monitor.DonationContract(min_bytes=float(64 << 10)),
+            monitor.TraceStability(),
+        ]
+        lint_report = monitor.run_lint(subject, rules)
+        print(lint_report.summary(), file=sys.stderr)
+        if not lint_report.ok:
+            raise SystemExit(1)
+
+    # ---- donation hoists: state.master aliases params32 (no-copy
+    # astype), and the first runN call donates state — so everything
+    # below that reads params32 VALUES is computed here, before any
+    # donating call. (`.size` reads for the param count are metadata
+    # and stay where they are.)
+    w_emb = hidden0 = None
+    if loss == "fused" and tp == 1:
+        from rocm_apex_tpu.ops.linear_xentropy import (
+            linear_cross_entropy_mean,
+        )
+
+        w_emb = jnp.array(
+            params32["params"]["embedding"]["word_embeddings"]["weight"],
+            dtype=cfg.dtype,  # forced copy: must outlive the donation
+        )
+        hidden0 = jax.random.normal(
+            jax.random.PRNGKey(3), (batch, seq, cfg.hidden_size),
+            cfg.dtype,
+        )
+    if packed_update:
+        from rocm_apex_tpu.optimizers.packed import PackedOptimizerStep
+
+        popt = PackedOptimizerStep("adam", 1e-4, weight_decay=0.01)
+        # packed init packs masters into FRESH flat buffers — no alias
+        pstate = popt.init(params32)
+        grads_fix = jax.tree_util.tree_map(
+            lambda p: (p * 1e-3 + 1e-5).astype(cfg.dtype), params32
+        )
+        # the tree-optimizer master tree aliases params32; deep-copy so
+        # the update-phase timing below survives the donating runN calls
+        upd_state_tree = jax.tree_util.tree_map(
+            jnp.array, opt.init(params32)
+        )
+        upd_state_packed = popt.init(params32)
 
     state, sstate, rng0, losses = runN(state, sstate, rng0)
     float(losses[-1])  # warmup + sync (value fetch, not block_until_ready)
@@ -1633,16 +1741,7 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
     # would measure a different (1/tp) head.
     head_ms = None
     if loss == "fused" and tp == 1:
-        from rocm_apex_tpu.ops.linear_xentropy import (
-            linear_cross_entropy_mean,
-        )
-
-        w_emb = params32["params"]["embedding"]["word_embeddings"][
-            "weight"
-        ].astype(cfg.dtype)
-        hidden0 = jax.random.normal(
-            jax.random.PRNGKey(3), (batch, seq, cfg.hidden_size), cfg.dtype
-        )
+        # w_emb/hidden0 were hoisted above the first donating runN call
 
         def head_step(carry):
             h, acc = carry
@@ -1703,10 +1802,8 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         # baseline just measured, then isolate the update phase and the
         # traced program size so the three claims — step time, update
         # share, O(dtype-groups) equations — each get their own number.
-        from rocm_apex_tpu.optimizers.packed import PackedOptimizerStep
-
-        popt = PackedOptimizerStep("adam", 1e-4, weight_decay=0.01)
-        pstate = popt.init(params32)
+        # popt/pstate/grads_fix/upd states were hoisted above the first
+        # donating runN call (they read params32 values)
         one_step_p = make_one_step(popt)
 
         def local_runN_p(state, sstate, rng):
@@ -1716,7 +1813,7 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
             )
             return state, sstate, rng, losses
 
-        runN_p = jax.jit(local_runN_p)
+        runN_p = jax.jit(local_runN_p, donate_argnums=(0, 1))
         pstate, psstate, prng, plosses = runN_p(
             pstate, scaler.init(), rng0
         )
@@ -1743,9 +1840,6 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
 
         # update-phase share: the bare optimizer step on fixed grads
         # (bench_optim idiom), tree vs packed, outside the fwd/bwd
-        grads_fix = jax.tree_util.tree_map(
-            lambda p: (p * 1e-3 + 1e-5).astype(cfg.dtype), params32
-        )
 
         def upd_tree(carry):
             s, g = carry
@@ -1758,10 +1852,10 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
             return s2, g
 
         ms_upd_tree = _timed_scan(
-            upd_tree, (opt.init(params32), grads_fix), iters
+            upd_tree, (upd_state_tree, grads_fix), iters
         )
         ms_upd_packed = _timed_scan(
-            upd_packed, (popt.init(params32), grads_fix), iters
+            upd_packed, (upd_state_packed, grads_fix), iters
         )
 
         # traced-program size of the bare update (monitor/audit.py
@@ -1770,11 +1864,11 @@ def main(dropout: float = 0.1, seq: int = 0, batch: int = 0,
         # here and pinned by tests/L0/test_packed_optimizers.py
         rep_tree = monitor.audit(
             lambda s, g: opt.step_and_probe(s, g, grad_scale=1.0),
-            opt.init(params32), grads_fix,
+            upd_state_tree, grads_fix,
         )
         rep_packed = monitor.audit(
             lambda s, g: popt.step_and_probe(s, g, grad_scale=1.0),
-            popt.init(params32), grads_fix,
+            upd_state_packed, grads_fix,
         )
         n_leaves = len(jax.tree_util.tree_leaves(params32))
         print(
@@ -1834,6 +1928,8 @@ if __name__ == "__main__":
             kwargs["collective_matmul"] = True
         elif a == "--audit":
             kwargs["audit"] = True
+        elif a == "--lint":
+            kwargs["lint"] = True
         elif a.startswith("--loss="):
             kwargs["loss"] = a.split("=", 1)[1]
         elif a.startswith("--budget="):
@@ -1882,6 +1978,8 @@ if __name__ == "__main__":
         raise SystemExit("--loss applies to the gpt bench")
     if "audit" in kwargs and which != "gpt":
         raise SystemExit("--audit applies to the gpt bench")
+    if "lint" in kwargs and which != "gpt":
+        raise SystemExit("--lint applies to the gpt bench")
     if (
         "seq_parallel" in kwargs or "collective_matmul" in kwargs
     ) and which != "gpt":

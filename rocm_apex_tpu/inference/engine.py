@@ -255,6 +255,7 @@ class InferenceEngine:
         watchdog_timeout: Optional[float] = None,
         watchdog_dump_path: Optional[str] = None,
         flight_recorder=None,
+        donate_buffers: Optional[bool] = None,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -632,9 +633,16 @@ class InferenceEngine:
             return cache
 
         # cache buffers are DONATED: the step updates them in place on
-        # TPU. CPU (the test platform) cannot donate and would warn on
-        # every call, so donation is gated on the backend.
-        donate = (1,) if on_tpu() else ()
+        # TPU. On CPU (the test platform) the default is NO donation —
+        # the fault-retry path (`_call_device`) re-runs a step from the
+        # caller's still-live buffers, which donation would have
+        # deleted. `donate_buffers` overrides the gate both ways (the
+        # graph-contract linter lowers a donating engine to verify the
+        # aliasing contract without being on TPU).
+        if donate_buffers is None:
+            donate_buffers = on_tpu()
+        self.donate_buffers = bool(donate_buffers)
+        donate = (1,) if self.donate_buffers else ()
         self._prefill_fn = _prefill
         self._decode_fn = _decode_body
         self._mixed_fn = _mixed
@@ -645,7 +653,7 @@ class InferenceEngine:
         self._mixed_jit = jax.jit(_mixed, donate_argnums=donate)
         self._mixed_spec_jit = jax.jit(_mixed_spec, donate_argnums=donate)
         self._commit_jit = jax.jit(
-            _commit, donate_argnums=(0,) if on_tpu() else ()
+            _commit, donate_argnums=(0,) if self.donate_buffers else ()
         )
 
     # ------------------------------------------------------------------

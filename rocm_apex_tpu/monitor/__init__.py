@@ -16,6 +16,13 @@ this package is the shared layer the ROADMAP's production story needs:
   collective counts/bytes and dot FLOPs — the executable form of the
   PR-3 "no gathered activation / ring collectives" invariants, and
   bench.py's ``--audit`` report;
+* **graph-contract linter** (`lint.py`): declarative rules checked
+  against traced programs — precision policy, materialization
+  budgets, collective contracts, donation, trace stability — the
+  policy layer over the auditor's accounting; `tools/graphlint.py`
+  diffs a registry of named configs against the checked-in
+  `tools/graph_contracts.json` manifest (CI gate), and bench.py grows
+  a ``--lint`` flag;
 * **span tracer** (`trace.py`): host-side wall-clock spans in a
   thread-safe ring buffer, exported as Perfetto-loadable Chrome trace
   JSON and aligned with device captures via
@@ -51,6 +58,18 @@ from rocm_apex_tpu.monitor.logger import (
     TensorBoardWriter,
     device_memory_stats,
 )
+from rocm_apex_tpu.monitor.lint import (
+    CollectiveContract,
+    DonationContract,
+    LintReport,
+    LintSubject,
+    NoMaterialization,
+    PrecisionPolicy,
+    TraceStability,
+    Violation,
+    run_lint,
+    walk_eqns,
+)
 from rocm_apex_tpu.monitor.metrics import Metrics, activation_stats, tree_norm
 from rocm_apex_tpu.monitor.recorder import FlightRecorder, group_nonfinite
 from rocm_apex_tpu.monitor.trace import NULL_TRACER, Tracer
@@ -72,6 +91,16 @@ __all__ = [
     "audit",
     "audit_jaxpr",
     "assert_no_intermediate",
+    "Violation",
+    "LintReport",
+    "LintSubject",
+    "run_lint",
+    "walk_eqns",
+    "PrecisionPolicy",
+    "NoMaterialization",
+    "CollectiveContract",
+    "DonationContract",
+    "TraceStability",
     "Tracer",
     "NULL_TRACER",
     "FlightRecorder",

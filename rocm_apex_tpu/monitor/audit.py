@@ -18,8 +18,10 @@ tests/L0. This module owns them:
 The walk recurses into every subjaxpr — pjit, `lax.scan` (inner counts
 multiply by the trip count), cond (branches merge by MAX: one branch
 executes), while (body counted once, flagged as a lower bound),
-custom_jvp/custom_vjp, remat, shard_map — so counts reflect the whole
-program, not its top level.
+custom_jvp_call/custom_vjp_call, closed_call, remat, shard_map — so
+counts reflect the whole program, not its top level (`_inner_jaxprs`
+is the coverage contract, regression-pinned per primitive in
+tests/L0/test_monitor.py).
 
 Accounting conventions (kept deliberately simple and documented, not
 clever):
@@ -273,14 +275,27 @@ def _wire_estimate(name, eqn, payload: float) -> float:
 
 
 def _inner_jaxprs(params):
-    """Every (Closed)Jaxpr hiding in an equation's params."""
-    for v in params.values():
-        if isinstance(v, (jax_core.Jaxpr, jax_core.ClosedJaxpr)):
-            yield v
-        elif isinstance(v, (tuple, list)):
-            for item in v:
-                if isinstance(item, (jax_core.Jaxpr, jax_core.ClosedJaxpr)):
-                    yield item
+    """Every (Closed)Jaxpr hiding in an equation's params.
+
+    This is the walker's coverage contract: any call-like primitive
+    whose body rides in its params — pjit, scan/cond/while branches,
+    custom_jvp_call / custom_vjp_call (``call_jaxpr`` + the rule
+    thunks), `closed_call`, remat, shard_map — is found here, so rules
+    and audits see primitives hidden under them. Containers recurse to
+    any depth (cond carries a tuple of branches; some primitives stash
+    jaxprs in dicts or nested tuples)."""
+    yield from _jaxprs_in(list(params.values()))
+
+
+def _jaxprs_in(value):
+    if isinstance(value, (jax_core.Jaxpr, jax_core.ClosedJaxpr)):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for item in value:
+            yield from _jaxprs_in(item)
+    elif isinstance(value, dict):
+        for item in value.values():
+            yield from _jaxprs_in(item)
 
 
 def _walk(jaxpr) -> AuditReport:

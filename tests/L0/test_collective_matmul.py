@@ -36,7 +36,8 @@ from rocm_apex_tpu.models.gpt import (
     ParallelTransformer,
     gpt_pipeline_functions,
 )
-from rocm_apex_tpu.monitor import assert_no_intermediate, audit
+from rocm_apex_tpu import monitor
+from rocm_apex_tpu.monitor import audit
 from rocm_apex_tpu.ops.collective_matmul import (
     all_gather_matmul,
     matmul_reduce_scatter,
@@ -419,7 +420,9 @@ class TestNoGatheredActivationInJaxpr:
             step, mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
             check_rep=False,
         )
-        return audit(f, x_loc)
+        return monitor.LintSubject.from_fn(
+            f"cm_stack_cm{int(collective_matmul)}_chunk{chunk}", f, x_loc
+        )
 
     def test_collective_matmul_stack_has_no_full_activation(self):
         """The acceptance bar made executable: with the ring boundary
@@ -432,24 +435,34 @@ class TestNoGatheredActivationInJaxpr:
         identically, does contain the gather (so the probe itself is
         sound)."""
         full = (self.B, self.S, self.H)
-        blocking = self._stack_report(collective_matmul=False)
+        blocking = self._stack_report(collective_matmul=False).report
         # probe sanity: the gather exists and uses plain collectives
         assert blocking.has_intermediate(full)
         assert blocking.count("all_gather") > 0
         assert blocking.count("ppermute") == 0
-        ring = assert_no_intermediate(
-            self._stack_report(collective_matmul=True), full
-        )
+        subject = self._stack_report(collective_matmul=True)
+        monitor.run_lint(subject, self._ring_rules()).raise_if_failed()
+        ring = subject.report
         assert ring.has_intermediate((self.B, self.S // 2, self.H))
         assert ring.count("ppermute") > 0
-        assert ring.count("all_gather") == 0
-        assert ring.count("reduce_scatter") == 0
+
+    def _ring_rules(self):
+        """The ring contract as declarative lint rules — the form
+        `tools/graphlint.py` pins in CI (spcm_tp2 config)."""
+        return [
+            monitor.NoMaterialization(
+                forbidden_shapes=((self.B, self.S, self.H),)
+            ),
+            monitor.CollectiveContract(
+                forbid=("all_gather", "reduce_scatter")
+            ),
+        ]
 
     def test_chunked_ring_also_clean(self):
-        assert_no_intermediate(
+        monitor.run_lint(
             self._stack_report(collective_matmul=True, chunk=8),
-            (self.B, self.S, self.H),
-        )
+            self._ring_rules(),
+        ).raise_if_failed()
 
     def test_no_async_flag_disables_the_ring(self):
         """`no_async_tensor_model_parallel_allreduce=True` is the

@@ -17,7 +17,8 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-from rocm_apex_tpu.monitor import assert_no_intermediate, audit
+from rocm_apex_tpu import monitor
+from rocm_apex_tpu.monitor import audit
 from rocm_apex_tpu.ops.linear_xentropy import (
     linear_cross_entropy_loss,
     linear_cross_entropy_mean,
@@ -220,18 +221,20 @@ class TestNoMaterializedLogits:
         chunked = (CHUNK, V)
         naive = audit(jax.grad(naive_step, (0, 1)), x, w)
         assert naive.has_intermediate(full)  # probe sanity
-        fused = assert_no_intermediate(
-            jax.grad(fused_step, (0, 1)), full, x, w
-        )
-        assert fused.has_intermediate(chunked)
 
         def mean_step(x, w):
             return linear_cross_entropy_mean(x, w, y, None, 0.0, None, CHUNK)
 
-        mean = assert_no_intermediate(
-            jax.grad(mean_step, (0, 1)), full, x, w
-        )
-        assert mean.has_intermediate(chunked)
+        # the same contract as a declarative lint rule (what
+        # tools/graphlint.py pins on the full train step): no full
+        # (rows, vocab) logits anywhere in fwd+bwd, only chunk tiles
+        rule = monitor.NoMaterialization(forbidden_shapes=(full,))
+        for name, step in (("fused", fused_step), ("mean", mean_step)):
+            subject = monitor.LintSubject.from_fn(
+                f"xent_{name}", jax.grad(step, (0, 1)), x, w
+            )
+            monitor.run_lint(subject, [rule]).raise_if_failed()
+            assert subject.report.has_intermediate(chunked)
 
 
 class TestVocabParallel:

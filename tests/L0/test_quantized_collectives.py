@@ -18,6 +18,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from _helpers import jit_shmap
 
+from rocm_apex_tpu import monitor
 from rocm_apex_tpu.contrib.optimizers import distributed_fused_adam
 from rocm_apex_tpu.monitor import audit
 from rocm_apex_tpu.ops.quantized_collectives import (
@@ -402,56 +403,20 @@ class TestFoundInfGatherSkip:
             )
         )(params, grads)
 
-    @staticmethod
-    def _subjaxprs(eqn):
-        from jax.core import ClosedJaxpr, Jaxpr
-
-        for v in eqn.params.values():
-            items = v if isinstance(v, (tuple, list)) else (v,)
-            for item in items:
-                if isinstance(item, ClosedJaxpr):
-                    yield item.jaxpr
-                elif isinstance(item, Jaxpr):
-                    yield item
-
-    @staticmethod
-    def _collect(jaxpr, name, out):
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name == name:
-                out.append(eqn)
-            for sub in TestFoundInfGatherSkip._subjaxprs(eqn):
-                TestFoundInfGatherSkip._collect(sub, name, out)
-
-    @staticmethod
-    def _count(jaxpr, names):
-        total = 0
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name in names:
-                total += 1
-            for sub in TestFoundInfGatherSkip._subjaxprs(eqn):
-                total += TestFoundInfGatherSkip._count(sub, names)
-        return total
-
     def test_skip_branch_has_no_collectives(self):
         """The found_inf cond has one branch with ZERO collectives (the
         frozen path: no param gather runs on a skipped step) and one
-        with the ppermute gather ring — pinned structurally because the
-        audit merges cond branches by max and cannot show the skip."""
-        jaxpr = self._trace_update("int8")
-        conds = []
-        self._collect(jaxpr.jaxpr, "cond", conds)
-        comm = {
-            "ppermute", "all_gather", "reduce_scatter", "psum_scatter",
-        }
-        found = False
-        for eqn in conds:
-            branch_comms = [
-                self._count(b.jaxpr, comm)
-                for b in eqn.params["branches"]
-            ]
-            if min(branch_comms) == 0 and max(branch_comms) > 0:
-                found = True
-        assert found, "no cond with a collective-free skip branch"
+        with the ppermute gather ring — pinned via the declarative
+        CollectiveContract lint rule because the audit merges cond
+        branches by max and cannot show the skip."""
+        subject = monitor.LintSubject.from_jaxpr(
+            "zero_int8_update", self._trace_update("int8")
+        )
+        report = monitor.run_lint(
+            subject,
+            [monitor.CollectiveContract(require_skip_cond=True)],
+        )
+        report.raise_if_failed()
 
     def test_skip_step_freezes_bitwise(self):
         """Behavioral pin: an overflowed step emits exact-zero updates

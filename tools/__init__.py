@@ -1,0 +1,3 @@
+"""Repo tooling (CI gates): importable so tests can drive the CLIs
+in-process — `tools.graphlint.main([...])` — instead of paying a cold
+jax import per subprocess."""
