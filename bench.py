@@ -345,7 +345,8 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
                 trace: str = "", paged: bool = False,
                 page_size: int = 0, kv_dtype: str = "",
                 shared_prefix: bool = False, spec_k: int = -1,
-                chaos: int = -1):
+                chaos: int = -1, slo: bool = False,
+                metrics_port: int = -1):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -398,6 +399,22 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     ``--paged``, every page back in the pool after the drain. Reports
     under ``gpt_serve_chaos_survival`` (vs_baseline = completed
     fraction). Same SEED, same schedule: a failure replays exactly.
+
+    ``--slo`` is the telemetry plane's acceptance rig: the measured
+    per-request TTFTs are replayed through a real `monitor.SLOMonitor`
+    (latency `SLO` over a ``serve_ttft_ms`` histogram, objective 0.9,
+    threshold = 2x the fault-free p95) on an EVENT-INDEX clock — one
+    request per tick, so the Google-SRE window math runs over request
+    counts and the asserts cannot flake on wall-clock jitter. Alone it
+    asserts the fault-free run stays QUIET (zero burn-rate alerts).
+    Composed with ``--chaos=SEED`` it first calibrates the threshold
+    on a fault-free pass (asserted quiet), then augments the fault
+    plan with a burst of retry-backoff device-step faults and asserts
+    the TTFT burn-rate alert FIRES. Reports under
+    ``gpt_serve_slo_alerts``. ``--metrics-port=N`` stands up the
+    telemetry exporter over the measured engine's registry on
+    127.0.0.1:N (0 = ephemeral) and self-scrapes ``/metrics`` and
+    ``/healthz`` once before exiting.
 
     ``--spec-k=K`` A/Bs speculative decoding (n-gram self-drafting
     through the mixed step, `inference/drafting.py`) against the
@@ -621,30 +638,128 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
         gen = sum(len(r.tokens) for r in results)
         return eng, results, gen / dt, dt
 
+    def slo_replay_ttft(completions, threshold_ms):
+        # replay the measured per-request TTFTs through the real SLO
+        # machinery on an EVENT-INDEX clock (one request = one tick):
+        # the burn-rate windows count requests, not seconds, so the
+        # assert is deterministic while still exercising
+        # Histogram.good_below, the window differencing, and the
+        # rising-edge alert path end to end
+        from rocm_apex_tpu.monitor import BurnRule, MetricRegistry, SLO, SLOMonitor
+
+        reg = MetricRegistry()
+        hist = reg.histogram(
+            "serve_ttft_ms",
+            "Replayed enqueue->first-token latency (ms).",
+        )
+        mon = SLOMonitor(registry=reg)
+        mon.add(SLO(
+            "serve_ttft", 0.9, series=hist, threshold=threshold_ms,
+            # request-counted windows: any 6-request span burning the
+            # 10% error budget at >= 2x, confirmed by its trailing 3,
+            # trips the rule
+            windows=(BurnRule(6.0, 3.0, 2.0),),
+        ))
+        mon.tick(now=0.0)  # pre-traffic baseline sample
+        # requests shed/cancelled before their first token carry
+        # ttft_ms == 0 — no latency was observed, nothing to judge
+        ttfts = [
+            c["ttft_ms"] for c in completions if c["ttft_ms"] > 0
+        ]
+        for i, t in enumerate(ttfts):
+            hist.observe(t)
+            mon.tick(now=float(i + 1))
+            mon.alerts(now=float(i + 1))
+        return mon
+
+    def scrape_metrics(eng):
+        # --metrics-port: stand the exporter up over the measured
+        # engine's registry and self-scrape each endpoint once — the
+        # bench proves the surface; a deployment would leave it up
+        import http.client
+        import json as _json
+
+        srv = monitor.start_exporter(
+            eng.registry, port=metrics_port, engine=eng
+        )
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=10
+            )
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 200 and b"serve_ttft_ms_count" in body, (
+                f"/metrics scrape failed: status={resp.status}"
+            )
+            conn.request("GET", "/healthz")
+            hz = conn.getresponse()
+            healthy = _json.loads(hz.read()).get("healthy")
+            conn.close()
+            print(
+                f"serve metrics: {srv.url} — /metrics {len(body)} "
+                f"bytes, /healthz status={hz.status} healthy={healthy}",
+                file=sys.stderr,
+            )
+        finally:
+            srv.close()
+
     if chaos >= 0:
         from rocm_apex_tpu.inference import FINISH_REASONS, Fault, FaultPlan
 
         kv = jnp.int8 if kv_dtype == "int8" else None
         ps = page_size or (64 if on_tpu else 16)
+        ttft_threshold = 0.0
+        backoff = 0.0
+        if slo:
+            # calibration: the same workload fault-free fixes the
+            # alert threshold (2x its ttft p95) and must stay quiet
+            # against it — the no-false-positive half of the assert
+            eng_cal, _, _, _ = run(True)
+            p95_cal = eng_cal.stats()["ttft_ms_p95"]
+            ttft_threshold = max(2.0 * p95_cal, 1.0)
+            mon_quiet = slo_replay_ttft(
+                eng_cal.completions, ttft_threshold
+            )
+            assert not mon_quiet.events, (
+                f"fault-free calibration run tripped the TTFT burn "
+                f"alert: {mon_quiet.events}"
+            )
+            backoff = min(1.0, max(0.05, p95_cal / 1000.0))
         # the schedule derives from SEED alone, so a red run replays
         # bit-for-bit with the same command line
         rng_c = np.random.RandomState(chaos)
-        plan = FaultPlan([
+        faults = [
             Fault(site="device_step", tick=int(rng_c.randint(1, 5))),
             Fault(site="logits", tick=int(rng_c.randint(5, 10)),
                   payload={"slot": int(rng_c.randint(0, num_slots))}),
             Fault(site="host_fetch", p=0.05, times=2),
             # consulted on the paged engine only; 0 fires on contiguous
             Fault(site="page_alloc", nth=int(rng_c.randint(2, 7))),
-        ], seed=chaos)
+        ]
+        if slo:
+            # latency burst: six consecutive mid-run ticks each lose
+            # one device-step attempt (distinct ticks, times=1 each —
+            # retries cannot exhaust on them) and step_retry_backoff
+            # stalls each retry ~one fault-free p95, so the requests
+            # queued behind the burst blow through the 2x-p95 alert
+            # threshold while the early wave stays under it
+            faults.extend(
+                Fault(site="device_step", tick=t)
+                for t in range(10, 16)
+            )
+        plan = FaultPlan(faults, seed=chaos)
         eng = InferenceEngine(
             model, params, num_slots=num_slots, capacity=capacity,
             max_prompt_len=max(lens),
             sampling=SamplingParams(temperature=0.0), seed=0,
             prefill_token_budget=budget, faults=plan,
             # p=0.05 times=2 can never out-fire 3 attempts — the plan
-            # is chaotic, not unrecoverable
-            max_step_retries=2,
+            # is chaotic, not unrecoverable (under --slo the burst
+            # adds ONE deterministic fire per tick, so the margin
+            # needs one more retry)
+            max_step_retries=3 if slo else 2,
+            step_retry_backoff=backoff,
             # bounded admission: the last 2 submissions shed
             max_queue=n_requests - 2,
             paged=paged, page_size=ps if paged else 16,
@@ -718,6 +833,22 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
             f"{'no page leak; ' if paged else ''}"
             f"fault fires {dict(plan.fires)}",
         )
+        if slo:
+            mon_chaos = slo_replay_ttft(eng.completions, ttft_threshold)
+            n_alerts = len(mon_chaos.events)
+            assert n_alerts > 0, (
+                f"chaos latency burst did not trip the TTFT burn-rate "
+                f"alert (threshold {ttft_threshold:.0f} ms, fires "
+                f"{dict(plan.fires)})"
+            )
+            _report(
+                "gpt_serve_slo_alerts", float(n_alerts), "alerts", 1.0,
+                f"ttft burn-rate: chaos fired {n_alerts} alert(s) at "
+                f"threshold {ttft_threshold:.0f} ms (2x fault-free "
+                f"p95); fault-free calibration pass stayed quiet",
+            )
+        if metrics_port >= 0:
+            scrape_metrics(eng)
         return
 
     if paged or shared_prefix:
@@ -891,6 +1022,24 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
             f"prefill_traces={eng.prefill_trace_count}",
             file=sys.stderr,
         )
+    if slo:
+        # fault-free serving must not page anyone: replay the measured
+        # run's TTFTs against a threshold budgeted off its own p95 —
+        # the quiet half of the --chaos --slo acceptance pair
+        s_m = out[traced_mode][1]
+        thresh = max(2.0 * s_m["ttft_ms_p95"], 1.0)
+        mon = slo_replay_ttft(eng.completions, thresh)
+        assert not mon.events, (
+            f"fault-free serve run tripped the TTFT burn alert: "
+            f"{mon.events}"
+        )
+        _report(
+            "gpt_serve_slo_alerts", 0.0, "alerts", 1.0,
+            f"ttft burn-rate quiet on the fault-free {traced_mode} "
+            f"run (threshold {thresh:.0f} ms = 2x its p95)",
+        )
+    if metrics_port >= 0:
+        scrape_metrics(eng)
     if whole_prompt:
         tok_s, s, _ = out["whole"]
         _report("gpt_serve_tokens_per_sec_per_chip_whole", tok_s,
@@ -1950,6 +2099,10 @@ if __name__ == "__main__":
             kwargs["spec_k"] = int(a.split("=", 1)[1])
         elif a.startswith("--chaos="):
             kwargs["chaos"] = int(a.split("=", 1)[1])
+        elif a == "--slo":
+            kwargs["slo"] = True
+        elif a.startswith("--metrics-port="):
+            kwargs["metrics_port"] = int(a.split("=", 1)[1])
         elif a == "--dist-opt":
             kwargs["dist_opt"] = True
         elif a.startswith("--comm-dtype="):
@@ -1991,17 +2144,29 @@ if __name__ == "__main__":
         or "trace" in kwargs or "paged" in kwargs
         or "page_size" in kwargs or "kv_dtype" in kwargs
         or "shared_prefix" in kwargs or "spec_k" in kwargs
-        or "chaos" in kwargs
+        or "chaos" in kwargs or "slo" in kwargs
+        or "metrics_port" in kwargs
     ) and which != "serve":
         raise SystemExit(
             "--budget/--whole-prompt/--trace/--paged/--page-size/"
-            "--kv-dtype/--shared-prefix/--spec-k/--chaos apply to the "
-            "serve bench"
+            "--kv-dtype/--shared-prefix/--spec-k/--chaos/--slo/"
+            "--metrics-port apply to the serve bench"
         )
     if kwargs.get("spec_k", 0) < 0:
         raise SystemExit("--spec-k must be >= 0")
     if kwargs.get("chaos", 0) < 0:
         raise SystemExit("--chaos takes a seed >= 0")
+    if kwargs.get("metrics_port", 0) < 0:
+        raise SystemExit("--metrics-port takes a port >= 0 (0 = ephemeral)")
+    if ("slo" in kwargs or "metrics_port" in kwargs) and (
+        kwargs.get("shared_prefix") or "spec_k" in kwargs
+        or (kwargs.get("paged") and "chaos" not in kwargs)
+    ):
+        raise SystemExit(
+            "--slo/--metrics-port instrument the mixed-workload serve "
+            "pass (plain or --chaos); they do not compose with "
+            "--shared-prefix/--spec-k/--paged-without-chaos"
+        )
     if "chaos" in kwargs and (
         kwargs.get("shared_prefix") or "spec_k" in kwargs
         or kwargs.get("whole_prompt")

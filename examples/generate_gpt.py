@@ -100,6 +100,13 @@ def main():
                         " serving timelines to PATH (load in Perfetto)"
                         " and per-request completion records to"
                         " PATH.requests.jsonl")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve /metrics (Prometheus text), /healthz "
+                        "(engine liveness), /varz (JSON) on "
+                        "127.0.0.1:PORT while the loop runs; 0 = "
+                        "ephemeral (the bound port is printed on the "
+                        "'metrics:' line)")
     args = p.parse_args()
 
     cfg = GPTConfig(
@@ -146,6 +153,16 @@ def main():
         spec_k=args.spec_k,
     )
 
+    exporter = None
+    if args.metrics_port is not None:
+        from rocm_apex_tpu.monitor import start_exporter
+
+        exporter = start_exporter(
+            eng.registry, port=args.metrics_port, engine=eng
+        )
+        # flush: the L1 smoke scrapes this address mid-run
+        print(f"metrics: {exporter.url}", flush=True)
+
     rng = np.random.RandomState(args.seed)
     prompts = [
         rng.randint(0, args.vocab_size,
@@ -191,6 +208,26 @@ def main():
               f"accepted={s['tokens_accepted']:.0f} "
               f"(acceptance={s['acceptance_rate']:.2f}) "
               f"rollbacks={s['rollbacks']:.0f}")
+    if exporter is not None:
+        # completion accounting: the registry counters, the delivered
+        # results, and stats() must tell one story (the L1 smoke
+        # asserts this line says "consistent")
+        c_done = eng.registry.get("serve_completions_total").total()
+        c_gen = eng.registry.get(
+            "serve_tokens_total"
+        ).value(phase="generated")
+        ok_acct = c_done == len(results) and c_gen == n_gen
+        if not drained:
+            ok_acct = ok_acct and c_done == s["evicted"] + s["shed"]
+        print(f"telemetry: completions={c_done:.0f}/{len(results)} "
+              f"generated_tokens={c_gen:.0f}/{n_gen} "
+              f"({'consistent' if ok_acct else 'MISMATCH'})",
+              flush=True)
+        exporter.close()
+        if not ok_acct:
+            raise SystemExit(
+                "telemetry counters disagree with results/stats()"
+            )
     if args.trace is not None:
         n = tracer.export_chrome_trace(args.trace)
         req_path = args.trace + ".requests.jsonl"

@@ -34,13 +34,19 @@ from rocm_apex_tpu.checkpoint import CheckpointManager
 from rocm_apex_tpu.contrib.optimizers import distributed_fused_adam
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
 from rocm_apex_tpu.monitor import (
+    SLO,
+    BurnRule,
     FlightRecorder,
     JsonlWriter,
+    MetricRegistry,
     Metrics,
     MetricsLogger,
+    RegistryWriter,
+    SLOMonitor,
     Tracer,
     group_nonfinite,
     model_flops,
+    start_exporter,
     tree_norm,
 )
 from rocm_apex_tpu.optimizers.mixed import MixedPrecisionAdam
@@ -64,6 +70,24 @@ def _observability_args(parser):
              "nonfinite probes ride the step metrics and a NaN/Inf "
              "anomaly dumps a jsonl bundle to PATH "
              "(monitor.FlightRecorder)",
+    )
+    g.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve /metrics (Prometheus text), /healthz, /varz on "
+             "127.0.0.1:PORT for the run's telemetry registry "
+             "(monitor.RegistryWriter mirror of every flushed "
+             "scalar); 0 = ephemeral, the bound port prints on the "
+             "'metrics:' line",
+    )
+    g.add_argument(
+        "--slo", type=float, default=None, const=-1.0, nargs="?",
+        metavar="MS",
+        help="arm a step-time SLO (objective: 90%% of steps finish "
+             "within MS milliseconds) with Google-SRE multi-window "
+             "burn-rate alerting (monitor.SLOMonitor); omit MS to "
+             "auto-set the threshold to 3x the first logging "
+             "window's mean step time. Alerts print at the end and "
+             "ride /varz when --metrics-port is set",
     )
     g2 = parser.add_argument_group(title="distributed optimizer")
     g2.add_argument(
@@ -400,6 +424,24 @@ def main():
     # with any live device capture via StepTraceAnnotation; exported
     # as Perfetto-loadable Chrome trace JSON at the end of the run
     tracer = Tracer(enabled=args.trace is not None)
+    # telemetry plane (--metrics-port / --slo): a RegistryWriter
+    # mirrors every flushed scalar into a MetricRegistry so the
+    # training run exports through the SAME /metrics + SLO surface as
+    # the serving engine (docs/observability.md "Telemetry & SLOs")
+    registry = None
+    slo_monitor = None
+    exporter = None
+    if args.metrics_port is not None or args.slo is not None:
+        registry = MetricRegistry()
+        logger.writers.append(RegistryWriter(registry))
+        if args.slo is not None:
+            slo_monitor = SLOMonitor(registry=registry, tracer=tracer)
+        if args.metrics_port is not None:
+            exporter = start_exporter(
+                registry, port=args.metrics_port,
+                slo_monitor=slo_monitor,
+            )
+            print(f"metrics: {exporter.url}", flush=True)
     # numerics flight recorder (--flight-recorder): the last-k metric
     # snapshots ride a host ring; a NaN/Inf anomaly dumps a jsonl
     # bundle naming the offending param group
@@ -423,6 +465,24 @@ def main():
                 )
                 logger.end_step(sync_on=metrics["loss"])  # fetch = sync
             record = logger.log_step(it + 1, metrics)
+            if record is not None and slo_monitor is not None:
+                if not slo_monitor.slos:
+                    # threshold: the flag's value, or 3x the first
+                    # window's mean step time (post-compile steady
+                    # state; the compile-heavy first window itself
+                    # never enters the histogram ring twice)
+                    thresh = (
+                        args.slo if args.slo > 0
+                        else 3.0 * record["step_time_ms"]
+                    )
+                    slo_monitor.add(SLO(
+                        "train_step_time", 0.9,
+                        series=registry.get("train_step_ms"),
+                        threshold=thresh,
+                        windows=(BurnRule(60.0, 15.0, 2.0),),
+                    ))
+                slo_monitor.tick()
+                slo_monitor.alerts()  # rising edges -> events/tracer
             if recorder is not None:
                 bundle = recorder.record(it + 1, metrics)
                 if bundle is not None:
@@ -474,6 +534,20 @@ def main():
         print(f"state digest: {h.hexdigest()}")
         mgr.wait_until_finished()
         mgr.close()
+    if slo_monitor is not None:
+        fired = slo_monitor.events
+        print(
+            f"slo: {len(fired)} burn-rate alert(s)"
+            + (
+                " — " + "; ".join(
+                    f"{e['slo']} burn={e['burn_long']:.1f}x "
+                    f"(factor {e['factor']:.1f})" for e in fired
+                ) if fired else ""
+            ),
+            file=sys.stderr,
+        )
+    if exporter is not None:
+        exporter.close()
     if args.trace is not None:
         n = tracer.export_chrome_trace(args.trace)
         print(f"wrote {n} trace events to {args.trace}", file=sys.stderr)

@@ -256,6 +256,8 @@ class InferenceEngine:
         watchdog_dump_path: Optional[str] = None,
         flight_recorder=None,
         donate_buffers: Optional[bool] = None,
+        registry=None,
+        stats_retention: int = 4096,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -403,10 +405,70 @@ class InferenceEngine:
         self._decode_seconds = 0.0
         self._decode_steps = 0
         self._mixed_steps = 0
-        self._queue_waits: List[float] = []
-        self._ttfts: List[float] = []
+        # Raw per-request samples keep EXACT percentiles while they
+        # fit; `stats_retention` caps them (oldest drop) so a
+        # long-lived engine has O(1) stats memory. The registry
+        # histograms below never drop — once the rings wrap, stats()
+        # switches to their bounded-error quantiles (see stats()).
+        if stats_retention < 1:
+            raise ValueError(
+                f"stats_retention must be >= 1, got {stats_retention}"
+            )
+        self.stats_retention = int(stats_retention)
+        self._queue_waits: collections.deque = collections.deque(
+            maxlen=self.stats_retention
+        )
+        self._ttfts: collections.deque = collections.deque(
+            maxlen=self.stats_retention
+        )
         # per-request completion records (host-side; see `completions`)
-        self._completions: List[Dict[str, float]] = []
+        self._completions: collections.deque = collections.deque(
+            maxlen=self.stats_retention
+        )
+        # Mergeable constant-memory telemetry (monitor/telemetry.py):
+        # a private enabled registry by default so every engine can be
+        # scraped / merged; pass monitor.NULL_REGISTRY to opt out
+        # (stats() then serves the capped rings only). All observation
+        # is host-side — the compiled programs gain ZERO equations
+        # (pinned by tools/graphlint.py fingerprints).
+        if registry is None:
+            from rocm_apex_tpu.monitor.telemetry import MetricRegistry
+
+            registry = MetricRegistry()
+        self.registry = registry
+        self._h_queue_wait = registry.histogram(
+            "serve_queue_wait_ms",
+            "Request queue wait (enqueue -> slot lease), ms.",
+        )
+        self._h_ttft = registry.histogram(
+            "serve_ttft_ms",
+            "Time to first token (enqueue -> first token), ms.",
+        )
+        self._h_tpot = registry.histogram(
+            "serve_tpot_ms",
+            "Mean inter-token time after the first token, ms.",
+        )
+        self._h_e2e = registry.histogram(
+            "serve_e2e_ms",
+            "Request end-to-end latency (enqueue -> finish), ms.",
+        )
+        self._c_completions = registry.counter(
+            "serve_completions_total",
+            "Finished requests by terminal finish_reason.",
+            labelnames=("finish_reason",),
+        )
+        self._c_tokens = registry.counter(
+            "serve_tokens_total",
+            "Tokens of finished requests, by phase "
+            "(prompt=ingested, generated=emitted).",
+            labelnames=("phase",),
+        )
+        self._g_queue_depth = registry.gauge(
+            "serve_queue_depth", "Requests waiting for a slot."
+        )
+        self._g_slots_active = registry.gauge(
+            "serve_slots_active", "Slots holding a live request."
+        )
         self.tracer = tracer if tracer is not None else NULL_TRACER
         # ---- robustness layer (ISSUE 12) -----------------------------
         # faults: the chaos harness (NO_FAULTS = the shared null plan —
@@ -716,8 +778,35 @@ class InferenceEngine:
         the first), ``e2e_ms``. Jsonl-ready: route through
         `monitor.JsonlWriter.emit` (``bench.py serve --trace`` and
         ``examples/generate_gpt.py --trace`` do). Cleared by
-        `reset_stats`."""
-        return self._completions
+        `reset_stats`; retention is capped at ``stats_retention``
+        records (oldest drop) — the registry counters/histograms keep
+        the full-traffic accounting in constant memory."""
+        return list(self._completions)
+
+    # -- telemetry recording (host-side only; one registry `enabled`
+    # -- check per sample, the NULL_TRACER discipline) ----------------
+
+    def _record_queue_wait(self, seconds: float) -> None:
+        self._queue_waits.append(seconds)
+        if self.registry.enabled:
+            self._h_queue_wait.observe(1e3 * seconds)
+
+    def _record_ttft(self, seconds: float) -> None:
+        self._ttfts.append(seconds)
+        if self.registry.enabled:
+            self._h_ttft.observe(1e3 * seconds)
+
+    def _record_completion(self, rec: Dict[str, float]) -> None:
+        self._completions.append(rec)
+        if self.registry.enabled:
+            self._c_completions.inc(
+                finish_reason=rec["finish_reason"]
+            )
+            self._c_tokens.inc(rec["prompt_tokens"], phase="prompt")
+            self._c_tokens.inc(rec["new_tokens"], phase="generated")
+            self._h_e2e.observe(rec["e2e_ms"])
+            if rec["new_tokens"] > 1:
+                self._h_tpot.observe(rec["tpot_ms"])
 
     def stats(self) -> Dict[str, float]:
         """Serving telemetry as one flat name→scalar dict — the
@@ -735,6 +824,20 @@ class InferenceEngine:
         (enqueue → slot lease) and ``ttft_ms_p50/95`` (enqueue →
         first token) — the tails that surface head-of-line blocking,
         which the averages above hide.
+
+        Stats memory is O(1): raw per-request samples are retained up
+        to ``stats_retention`` (default 4096, oldest drop) and the
+        percentiles are EXACT over them; once traffic exceeds the cap,
+        percentiles switch to the engine registry's constant-memory
+        log-bucket histograms (``serve_queue_wait_ms`` /
+        ``serve_ttft_ms``), whose quantile estimates carry the
+        documented relative error bound
+        ``monitor.telemetry.Histogram.error_bound`` (~26% hard bound
+        at 20 buckets/decade; typically <2% interpolated — see
+        docs/observability.md "Telemetry & SLOs"). With a disabled
+        registry (``monitor.NULL_REGISTRY``) the capped rings are the
+        only source and percentiles describe the newest
+        ``stats_retention`` requests.
 
         Paged-cache occupancy (zeros on the contiguous engine):
         ``pages_total``/``pages_used``/``page_occupancy`` (pages
@@ -768,8 +871,14 @@ class InferenceEngine:
         )
         decode_generated = self._generated_tokens - self._admitted
 
-        def _pct(values, q):
-            return float(np.percentile(values, q)) if values else 0.0
+        def _pct_ms(ring, hist, q):
+            # exact percentile while the capped ring still holds every
+            # sample; bounded-error histogram quantile once it wrapped
+            if self.registry.enabled and hist.count() > len(ring):
+                return float(hist.percentile(q))
+            if not ring:
+                return 0.0
+            return 1e3 * float(np.percentile(np.asarray(ring), q))
 
         # page-occupancy counters (zeros when not paged, so one
         # MetricsLogger schema serves both engines)
@@ -838,10 +947,14 @@ class InferenceEngine:
                 decode_generated / self._decode_seconds
                 if self._decode_seconds > 0 else 0.0
             ),
-            "queue_wait_ms_p50": 1e3 * _pct(self._queue_waits, 50),
-            "queue_wait_ms_p95": 1e3 * _pct(self._queue_waits, 95),
-            "ttft_ms_p50": 1e3 * _pct(self._ttfts, 50),
-            "ttft_ms_p95": 1e3 * _pct(self._ttfts, 95),
+            "queue_wait_ms_p50": _pct_ms(
+                self._queue_waits, self._h_queue_wait, 50
+            ),
+            "queue_wait_ms_p95": _pct_ms(
+                self._queue_waits, self._h_queue_wait, 95
+            ),
+            "ttft_ms_p50": _pct_ms(self._ttfts, self._h_ttft, 50),
+            "ttft_ms_p95": _pct_ms(self._ttfts, self._h_ttft, 95),
         }
 
     def reset_stats(self) -> None:
@@ -857,9 +970,18 @@ class InferenceEngine:
         self._decode_seconds = 0.0
         self._decode_steps = 0
         self._mixed_steps = 0
-        self._queue_waits = []
-        self._ttfts = []
-        self._completions = []
+        self._queue_waits.clear()
+        self._ttfts.clear()
+        self._completions.clear()
+        # zero the ENGINE's registry series in place (a shared
+        # registry's other families are untouched)
+        if self.registry.enabled:
+            for metric in (
+                self._h_queue_wait, self._h_ttft, self._h_tpot,
+                self._h_e2e, self._c_completions, self._c_tokens,
+                self._g_queue_depth, self._g_slots_active,
+            ):
+                metric.clear()
         self._cow_forks = 0
         self._prefix_hits = 0
         self._prefix_hit_tokens = 0
@@ -955,7 +1077,7 @@ class InferenceEngine:
             # the completion records and delivered as a queue_full
             # result through the next step()
             self._shed += 1
-            self._completions.append({
+            self._record_completion({
                 "request_id": request_id,
                 "finish_reason": "queue_full",
                 "prompt_tokens": len(prompt),
@@ -1017,6 +1139,11 @@ class InferenceEngine:
             out.extend(self._step_whole())
         self._tick += 1
         self._note_progress()
+        if self.registry.enabled:
+            # live occupancy gauges for the async /metrics scrape
+            # (host-side sets; the compiled programs are untouched)
+            self._g_queue_depth.set(self.num_queued)
+            self._g_slots_active.set(self.num_active)
         return out
 
     def cancel(self, request_id: int) -> Optional[GenerationResult]:
@@ -1333,7 +1460,7 @@ class InferenceEngine:
                 continue
             req = self._queue.popleft()
             self._admitted += 1
-            self._queue_waits.append(now - req.enqueued_at)
+            self._record_queue_wait(now - req.enqueued_at)
             st = _Slot(
                 req=req, generated=[], pos=0, cursor=0,
                 prefix=list(req.prompt), leased_at=now,
@@ -1507,7 +1634,7 @@ class InferenceEngine:
         un-deliver it)."""
         carried = self._preempted.pop(req.request_id, None)
         tokens = list(carried[0]) if carried is not None else []
-        self._completions.append({
+        self._record_completion({
             "request_id": req.request_id,
             "finish_reason": reason,
             "prompt_tokens": len(req.prompt),
@@ -2003,7 +2130,7 @@ class InferenceEngine:
             st.generated.append(int(chunk_out[idx]))
             self._generated_tokens += 1
             st.first_token_at = now2
-            self._ttfts.append(now2 - st.req.enqueued_at)
+            self._record_ttft(now2 - st.req.enqueued_at)
             done = self._finish_reason(st)
             if done is not None:
                 # any fused decode output for this slot is discarded
@@ -2153,7 +2280,7 @@ class InferenceEngine:
             if self._slots[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
-            self._queue_waits.append(t_admit - req.enqueued_at)
+            self._record_queue_wait(t_admit - req.enqueued_at)
             if self.tracer.enabled:
                 self.tracer.add_span(
                     "queue_wait", req.enqueued_at, t_admit,
@@ -2189,7 +2316,7 @@ class InferenceEngine:
                 st.generated.append(int(tok))
                 self._generated_tokens += 1
                 st.first_token_at = now
-                self._ttfts.append(now - st.req.enqueued_at)
+                self._record_ttft(now - st.req.enqueued_at)
                 if self.tracer.enabled:
                     self.tracer.add_span(
                         "prefill", st.leased_at, now,
@@ -2275,7 +2402,7 @@ class InferenceEngine:
         # the jsonl-ready per-request completion record: the same
         # perf_counter anchors the tracer spans and `stats()` use, so
         # the three reports can never disagree about one request
-        self._completions.append({
+        self._record_completion({
             "request_id": req.request_id,
             "finish_reason": reason,
             "prompt_tokens": len(req.prompt),

@@ -31,7 +31,19 @@ this package is the shared layer the ROADMAP's production story needs:
 * **flight recorder** (`recorder.py`): last-k step snapshots plus
   in-graph per-param-group nonfinite probes; on a NaN/Inf anomaly it
   dumps a jsonl bundle naming the offending group — a mid-run NaN
-  becomes a diagnosable artifact instead of a dead run.
+  becomes a diagnosable artifact instead of a dead run;
+* **telemetry plane** (`telemetry.py` / `slo.py` / `exporter.py`):
+  the production export surface — a mergeable constant-memory metric
+  registry (`Counter`/`Gauge`/`Histogram` with log-spaced buckets:
+  bucket-wise merge reproduces combined-stream percentiles, the
+  multi-replica prerequisite), declarative `SLO` objectives with
+  Google-SRE multi-window burn-rate alerts (`SLOMonitor`), and a
+  stdlib-only HTTP exporter (`TelemetryServer`) serving ``/metrics``
+  (Prometheus text), ``/healthz`` (engine watchdog/drain liveness),
+  and ``/varz`` (JSON incl. device-memory watermarks). The serving
+  engine's ``stats()`` rides the registry; `RegistryWriter` joins
+  training runs to the same plane; disabled registries follow the
+  `NULL_TRACER` zero-overhead idiom (`NULL_REGISTRY`).
 
 See docs/observability.md for the full tour; `rocm_apex_tpu.profiler`
 remains the trace-capture layer (device timelines), while this package
@@ -52,9 +64,15 @@ from rocm_apex_tpu.monitor.flops import (
     resnet50_train_flops,
     transformer_train_flops,
 )
+from rocm_apex_tpu.monitor.exporter import (
+    TelemetryServer,
+    engine_health,
+    start_exporter,
+)
 from rocm_apex_tpu.monitor.logger import (
     JsonlWriter,
     MetricsLogger,
+    RegistryWriter,
     TensorBoardWriter,
     device_memory_stats,
 )
@@ -72,6 +90,22 @@ from rocm_apex_tpu.monitor.lint import (
 )
 from rocm_apex_tpu.monitor.metrics import Metrics, activation_stats, tree_norm
 from rocm_apex_tpu.monitor.recorder import FlightRecorder, group_nonfinite
+from rocm_apex_tpu.monitor.slo import (
+    DEFAULT_BURN_RULES,
+    BurnRule,
+    SLO,
+    SLOMonitor,
+)
+from rocm_apex_tpu.monitor.telemetry import (
+    DEFAULT_REGISTRY,
+    NULL_REGISTRY,
+    CardinalityError,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    log_buckets,
+)
 from rocm_apex_tpu.monitor.trace import NULL_TRACER, Tracer
 
 __all__ = [
@@ -105,4 +139,20 @@ __all__ = [
     "NULL_TRACER",
     "FlightRecorder",
     "group_nonfinite",
+    "MetricRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "CardinalityError",
+    "log_buckets",
+    "DEFAULT_REGISTRY",
+    "NULL_REGISTRY",
+    "RegistryWriter",
+    "SLO",
+    "SLOMonitor",
+    "BurnRule",
+    "DEFAULT_BURN_RULES",
+    "TelemetryServer",
+    "engine_health",
+    "start_exporter",
 ]

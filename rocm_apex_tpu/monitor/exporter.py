@@ -1,0 +1,222 @@
+"""Dependency-free HTTP exporter: /metrics, /healthz, /varz.
+
+The scrape surface for `monitor.telemetry` registries, built on the
+stdlib `http.server` only (the container bakes no Prometheus client;
+the text exposition format needs none):
+
+* ``GET /metrics`` — Prometheus text exposition (version 0.0.4) of
+  the attached registry, the body `MetricRegistry.exposition` renders.
+* ``GET /healthz`` — JSON liveness. With a ``health_fn`` attached
+  (e.g. `engine_health(engine)` — the serving engine's watchdog /
+  drain / progress state from the request-lifecycle layer), an
+  unhealthy report answers **503** so a load balancer or k8s probe
+  can act on it; healthy (or no health_fn) answers 200.
+* ``GET /varz`` — one JSON dump for humans and scripts: the registry
+  snapshot, `device_memory_stats()` watermarks for every local
+  device, SLO burn-rate status when an `SLOMonitor` is attached, and
+  anything the optional ``varz_fn`` adds.
+
+**Security note:** the server binds ``127.0.0.1`` by default and
+serves read-only GETs with no auth — telemetry is an information
+leak (model shapes, traffic rates, tenant labels), so only bind a
+routable address on a network you already trust, behind your own
+auth/scrape proxy. ``port=0`` asks the kernel for an ephemeral port;
+read it back from ``server.port`` (bench/examples print it).
+
+The server runs on a daemon thread (`ThreadingHTTPServer`, one thread
+per in-flight scrape); registry reads take the registry lock, never
+the GIL-free engine hot path. `close()` is idempotent.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from rocm_apex_tpu.monitor.telemetry import MetricRegistry
+
+__all__ = [
+    "TelemetryServer",
+    "engine_health",
+    "start_exporter",
+    "PROMETHEUS_CONTENT_TYPE",
+]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def engine_health(engine) -> Callable[[], Dict[str, Any]]:
+    """Liveness report for an `inference.InferenceEngine`, fed by the
+    request-lifecycle state (PR 12): healthy means the stall watchdog
+    has not fired and the engine is not wedged mid-drain. Draining
+    itself is REPORTED but still healthy — a draining replica is alive
+    and must keep answering probes until the last request leaves."""
+
+    def _health() -> Dict[str, Any]:
+        fires = int(getattr(engine, "_watchdog_fires", 0))
+        return {
+            "healthy": fires == 0,
+            "draining": bool(getattr(engine, "draining", False)),
+            "watchdog_fires": fires,
+            "ticks": int(getattr(engine, "tick_count", 0)),
+            "queue_depth": int(getattr(engine, "num_queued", 0)),
+            "slots_active": int(getattr(engine, "num_active", 0)),
+        }
+
+    return _health
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server object carries the telemetry context (set by
+    # TelemetryServer below); one handler class serves all routes
+    server_version = "rocm-apex-telemetry/1.0"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        ctx: "TelemetryServer" = self.server._telemetry  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = ctx.registry.exposition().encode()
+                self._send(200, body, PROMETHEUS_CONTENT_TYPE)
+            elif path == "/healthz":
+                report = ctx.health()
+                code = 200 if report.get("healthy", True) else 503
+                self._send(
+                    code, json.dumps(report).encode(),
+                    "application/json",
+                )
+            elif path == "/varz":
+                self._send(
+                    200, json.dumps(ctx.varz()).encode(),
+                    "application/json",
+                )
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as exc:  # noqa: BLE001 - scrape must not kill
+            self._send(
+                500, f"telemetry error: {exc}\n".encode(),
+                "text/plain",
+            )
+
+
+class TelemetryServer:
+    """Background scrape endpoint over one registry.
+
+    ``port=0`` (default) binds an ephemeral port — read ``.port``
+    after `start`. ``host`` defaults to loopback (see the module
+    security note before changing it). Use as a context manager or
+    call `close()`; both are idempotent."""
+
+    def __init__(
+        self,
+        registry: MetricRegistry,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        varz_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        slo_monitor=None,
+    ):
+        self.registry = registry
+        self.health_fn = health_fn
+        self.varz_fn = varz_fn
+        self.slo_monitor = slo_monitor
+        self._host = host
+        self._want_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- route bodies (handler calls back in) ---------------------------
+
+    def health(self) -> Dict[str, Any]:
+        if self.health_fn is None:
+            return {"healthy": True}
+        return dict(self.health_fn())
+
+    def varz(self) -> Dict[str, Any]:
+        from rocm_apex_tpu.monitor.logger import device_memory_stats
+
+        out: Dict[str, Any] = {
+            "metrics": self.registry.snapshot(),
+            "health": self.health(),
+        }
+        try:
+            import jax
+
+            out["device_memory"] = [
+                device_memory_stats(d) for d in jax.local_devices()
+            ]
+        except Exception:  # noqa: BLE001 - varz must not require jax
+            out["device_memory"] = []
+        if self.slo_monitor is not None:
+            out["slo"] = self.slo_monitor.status()
+        if self.varz_fn is not None:
+            out.update(self.varz_fn())
+        return out
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        """The bound port (the ephemeral answer when constructed with
+        ``port=0``); 0 before `start`."""
+        if self._httpd is None:
+            return 0
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer(
+            (self._host, self._want_port), _Handler
+        )
+        httpd.daemon_threads = True
+        httpd._telemetry = self  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever,
+            name="telemetry-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_exporter(
+    registry: MetricRegistry, *, port: int = 0, engine=None, **kw
+) -> TelemetryServer:
+    """One-call convenience: start a `TelemetryServer`, wiring
+    `engine_health` automatically when an engine is passed. Returns
+    the started server (read ``.port`` / ``.url``)."""
+    if engine is not None and "health_fn" not in kw:
+        kw["health_fn"] = engine_health(engine)
+    return TelemetryServer(registry, port=port, **kw).start()

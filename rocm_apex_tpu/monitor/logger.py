@@ -21,7 +21,12 @@ bench driver's report rows). One `MetricsLogger` owns:
   `JsonlWriter` emits one JSON object per line (the bench driver's
   stdout contract); `TensorBoardWriter` adapts any
   ``add_scalar(tag, value, step)`` object — the same interface
-  `Timers.write` targets, so timers and metrics can share one sink.
+  `Timers.write` targets, so timers and metrics can share one sink;
+  `RegistryWriter` mirrors every flushed scalar into a
+  `monitor.telemetry.MetricRegistry` (gauges, plus a step-time
+  histogram), which is how a TRAINING run joins the same
+  ``/metrics`` + SLO plane the serving engine exports through
+  (``examples/gpt_train.py --metrics-port``).
 """
 
 import json
@@ -35,6 +40,7 @@ from rocm_apex_tpu.transformer._timers import Timers
 __all__ = [
     "JsonlWriter",
     "TensorBoardWriter",
+    "RegistryWriter",
     "MetricsLogger",
     "device_memory_stats",
 ]
@@ -116,6 +122,56 @@ class TensorBoardWriter:
 
     def add_scalar(self, tag: str, value, step: int) -> None:
         self._w.add_scalar(tag, float(value), int(step))
+
+
+class RegistryWriter:
+    """Writer-protocol sink into a `monitor.telemetry.MetricRegistry`.
+
+    Every flushed scalar becomes a gauge named
+    ``{prefix}{sanitized_name}`` (non-numeric entries like
+    ``platform`` skip), the flush step lands in ``{prefix}step``, and
+    ``step_time_ms`` is ADDITIONALLY observed into a
+    ``{prefix}step_ms`` histogram — the mergeable series a step-time
+    latency `monitor.slo.SLO` reads. Attach next to a `JsonlWriter`
+    and the same window flush feeds stdout AND the ``/metrics``
+    exporter (`monitor.exporter.TelemetryServer`)."""
+
+    _SANITIZE = None  # compiled lazily (module import stays cheap)
+
+    def __init__(self, registry, prefix: str = "train_"):
+        import re
+
+        if RegistryWriter._SANITIZE is None:
+            RegistryWriter._SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+        self._registry = registry
+        self._prefix = prefix
+        self._step_gauge = registry.gauge(
+            prefix + "step", "Latest flushed step index."
+        )
+        self._step_hist = registry.histogram(
+            prefix + "step_ms", "Step wall time, ms."
+        )
+
+    def _name(self, tag: str) -> str:
+        return self._prefix + RegistryWriter._SANITIZE.sub("_", tag)
+
+    def write(self, step: int, scalars: Dict[str, Any]) -> None:
+        self._step_gauge.set(int(step))
+        for tag, value in scalars.items():
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue  # non-scalar entries (e.g. 'platform') skip
+            self._registry.gauge(self._name(tag)).set(value)
+            if tag == "step_time_ms":
+                self._step_hist.observe(value)
+
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        """`Timers.write`-compatible single-scalar entry point."""
+        self.write(step, {tag: value})
+
+    def close(self) -> None:
+        pass
 
 
 class MetricsLogger:

@@ -113,6 +113,7 @@ class Tracer:
         enabled: bool = True,
         capacity: int = 65536,
         annotate_device: bool = True,
+        registry=None,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -122,6 +123,19 @@ class Tracer:
         self._t0 = self.clock()
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=capacity)
+        # ring-wrap visibility: a full ring drops the OLDEST event per
+        # append — count the drops (they used to be silent) and, when
+        # a telemetry registry is attached, export them as a counter
+        # alongside the serving metrics
+        self._dropped = 0
+        self._drop_counter = (
+            registry.counter(
+                "tracer_dropped_events_total",
+                "Trace events evicted by ring-buffer wrap "
+                "(raise Tracer(capacity=...) if nonzero).",
+            )
+            if registry is not None else None
+        )
         # track name -> tid, in registration order (Perfetto sorts by
         # the sort_index metadata we export, so registration order IS
         # display order: engine track first, then requests as admitted)
@@ -166,6 +180,7 @@ class Tracer:
         if not self.enabled:
             return
         with self._lock:
+            self._note_wrap_locked()
             self._events.append(
                 ("X", name, self._tid_locked(track), begin, end - begin, args)
             )
@@ -180,9 +195,24 @@ class Tracer:
         if ts is None:
             ts = self.clock()
         with self._lock:
+            self._note_wrap_locked()
             self._events.append(
                 ("i", name, self._tid_locked(track), ts, 0.0, args)
             )
+
+    def _note_wrap_locked(self) -> None:
+        """Called before an append: a full ring is about to evict its
+        oldest event — account the drop instead of losing it silently."""
+        if len(self._events) == self._events.maxlen:
+            self._dropped += 1
+            if self._drop_counter is not None:
+                self._drop_counter.inc()
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted by ring wrap since creation (`clear` does
+        not reset it — the count is about the tracer's lifetime)."""
+        return self._dropped
 
     def _tid_locked(self, track: Optional[str]) -> int:
         if track is None:
@@ -229,15 +259,23 @@ class Tracer:
         """Write the Perfetto-loadable JSON; returns the event count
         (metadata included)."""
         events = self.events()
+        other: Dict[str, Any] = {
+            "producer": "rocm_apex_tpu.monitor.trace",
+            "process_name": "host",
+            "dropped_events": self._dropped,
+        }
+        if self._dropped:
+            other["warning"] = (
+                f"{self._dropped} events dropped by ring-buffer wrap "
+                f"(capacity {self._events.maxlen}); the timeline is "
+                f"incomplete — raise Tracer(capacity=...)"
+            )
         with open(path, "w") as f:
             json.dump(
                 {
                     "traceEvents": events,
                     "displayTimeUnit": "ms",
-                    "otherData": {
-                        "producer": "rocm_apex_tpu.monitor.trace",
-                        "process_name": "host",
-                    },
+                    "otherData": other,
                 },
                 f,
             )

@@ -1,0 +1,621 @@
+"""Telemetry plane: registry, SLO burn rates, exporter (ISSUE-14).
+
+The acceptance bars under test:
+
+* histogram quantiles carry the DOCUMENTED error bound vs
+  ``np.percentile`` on seeded samples, and bucket-wise merge is exact
+  and associative — merged snapshots reproduce combined-stream
+  percentiles (the multi-replica aggregation story);
+* label cardinality is bounded (`CardinalityError`), disabled
+  registries are free no-ops (the `NULL_TRACER` idiom), and the
+  ``/metrics`` body round-trips through a real HTTP scrape as valid
+  Prometheus text exposition (cumulative monotone buckets, +Inf ==
+  count);
+* SLO burn-rate math fires on a synthetic bad burst and stays quiet
+  on a clean series — rising edges land in ``events``, the
+  ``slo_alerts_total`` counter, and the tracer;
+* the engine's ``stats()`` schema is unchanged and its percentiles
+  agree with the registry histograms within the error bound; raw
+  retention is capped (ring wrap falls back to histogram quantiles).
+
+Wall-time note (ROADMAP): the engine tests reuse test_inference's
+EXACT shape tuple (fp32_cfg model, slots=2, capacity=24, budget=4,
+init seq 8 / seed 1) so every compiled program is a compile-cache hit;
+everything else is host-only (zero compiles).
+"""
+
+import http.client
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_apex_tpu.inference import InferenceEngine, SamplingParams
+from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
+from rocm_apex_tpu.monitor import (
+    NULL_REGISTRY,
+    BurnRule,
+    CardinalityError,
+    MetricRegistry,
+    RegistryWriter,
+    SLO,
+    SLOMonitor,
+    TelemetryServer,
+    Tracer,
+    log_buckets,
+)
+from rocm_apex_tpu.monitor.exporter import PROMETHEUS_CONTENT_TYPE
+from rocm_apex_tpu.monitor.telemetry import _NULL_METRIC
+
+
+# ---------------------------------------------------------------------------
+# registry + histogram math (host-only)
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_get_or_create_identity_and_kind_mismatch(self):
+        reg = MetricRegistry()
+        c = reg.counter("requests_total", "help")
+        assert reg.counter("requests_total") is c
+        assert reg.get("requests_total") is c
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("requests_total")
+        with pytest.raises(ValueError, match="labelnames"):
+            reg.counter("requests_total", labelnames=("phase",))
+
+    def test_counter_semantics(self):
+        reg = MetricRegistry()
+        c = reg.counter("done_total", labelnames=("reason",))
+        c.inc(reason="length")
+        c.inc(2.0, reason="stop")
+        assert c.value(reason="length") == 1.0
+        assert c.value(reason="stop") == 2.0
+        assert c.value(reason="never") == 0.0
+        assert c.total() == 3.0
+        with pytest.raises(ValueError, match="only go up"):
+            c.inc(-1.0, reason="length")
+
+    def test_gauge_semantics(self):
+        reg = MetricRegistry()
+        g = reg.gauge("depth")
+        g.set(5.0)
+        g.inc()
+        g.dec(2.0)
+        assert g.value() == 4.0
+
+    def test_cardinality_guard(self):
+        reg = MetricRegistry(max_label_sets=3)
+        c = reg.counter("t_total", labelnames=("tenant",))
+        for i in range(3):
+            c.inc(tenant=f"t{i}")
+        with pytest.raises(CardinalityError):
+            c.inc(tenant="t3")
+        # existing label sets still work past the cap
+        c.inc(tenant="t0")
+        assert c.value(tenant="t0") == 2.0
+
+    def test_null_registry_is_free_and_shared(self):
+        assert not NULL_REGISTRY.enabled
+        m = NULL_REGISTRY.counter("x_total")
+        assert m is NULL_REGISTRY.histogram("y_ms") is _NULL_METRIC
+        # every verb is a no-op, nothing is registered
+        m.inc()
+        m.observe(3.0)
+        m.set(1.0)
+        m.clear()
+        assert m.value() == 0.0 and m.count() == 0.0
+        assert m.quantile(0.5) == 0.0
+        assert NULL_REGISTRY.families() == []
+        assert NULL_REGISTRY.exposition() == ""
+
+    def test_reset_zeroes_in_place(self):
+        reg = MetricRegistry()
+        c = reg.counter("n_total", labelnames=("k",))
+        h = reg.histogram("lat_ms")
+        c.inc(k="a")
+        h.observe(10.0)
+        reg.reset()
+        assert c.value(k="a") == 0.0
+        assert h.count() == 0.0
+        assert reg.counter("n_total", labelnames=("k",)) is c
+
+
+class TestLogBuckets:
+    def test_layout(self):
+        b = log_buckets(lo=1e-3, hi=1e7, per_decade=20)
+        assert b[0] == pytest.approx(1e-3)
+        assert b[-1] == pytest.approx(1e7)  # covers the full range
+        ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+        g = 10.0 ** (1.0 / 20.0)
+        assert all(r == pytest.approx(g) for r in ratios)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            log_buckets(lo=0.0)
+        with pytest.raises(ValueError):
+            log_buckets(lo=2.0, hi=1.0)
+        with pytest.raises(ValueError):
+            log_buckets(per_decade=0)
+
+
+class TestHistogramQuantiles:
+    def test_quantile_error_bound_vs_numpy(self):
+        """The documented contract: for in-range values the histogram
+        quantile is within ``error_bound`` RELATIVE error of the true
+        order statistic, on a heavy-tailed seeded sample."""
+        rng = np.random.RandomState(7)
+        samples = np.exp(rng.normal(3.0, 1.5, size=5000))  # ~0.1..1e4
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms")
+        for v in samples:
+            h.observe(float(v))
+        assert h.count() == len(samples)
+        assert h.sum() == pytest.approx(float(samples.sum()), rel=1e-9)
+        for q in (0.1, 0.5, 0.9, 0.95, 0.99):
+            est = h.quantile(q)
+            true = float(np.percentile(samples, 100 * q))
+            assert abs(est - true) / true <= h.error_bound, (
+                f"q={q}: est {est} vs true {true} "
+                f"(bound {h.error_bound})"
+            )
+
+    def test_good_below_rounds_up_to_bucket_bound(self):
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 3.0, 9.0):
+            h.observe(v)
+        # threshold 1.4 rounds UP to bound 2.0: 0.5 and 1.5 are good
+        assert h.good_below(1.4) == 2.0
+        assert h.good_below(4.0) == 3.0
+        assert h.good_below(100.0) == 4.0
+
+    def test_overflow_clamps_to_last_bound(self):
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0, 2.0))
+        h.observe(50.0)
+        assert h.quantile(0.99) == 2.0
+        assert h.quantile(0.5) == 2.0
+
+    def test_empty_and_bad_q(self):
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms")
+        assert h.quantile(0.5) == 0.0
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+
+class TestMerge:
+    def _filled(self, seed, n):
+        rng = np.random.RandomState(seed)
+        samples = np.exp(rng.normal(2.0, 1.0, size=n))
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms")
+        c = reg.counter("done_total", labelnames=("reason",))
+        g = reg.gauge("depth")
+        for v in samples:
+            h.observe(float(v))
+        c.inc(float(n), reason="length")
+        g.set(float(seed))
+        return reg, samples
+
+    def test_merge_is_exact_and_associative(self):
+        """(A + B) + C == A + (B + C) == the combined stream observed
+        into one registry — bucket-wise adds are exact, so replica
+        merge order cannot change a reported quantile."""
+        (ra, sa), (rb, sb), (rc, sc) = (
+            self._filled(1, 400), self._filled(2, 300),
+            self._filled(3, 500),
+        )
+        left = MetricRegistry()
+        left.merge_from(ra)
+        left.merge_from(rb)
+        left.merge_from(rc)
+        right = MetricRegistry()
+        bc = MetricRegistry()
+        bc.merge_from(rb)
+        bc.merge_from(rc)
+        right.merge_from(ra)
+        right.merge_from(bc)
+        combined, _ = self._filled(1, 400)
+        for v in np.concatenate([sb, sc]):
+            combined.get("lat_ms").observe(float(v))
+        combined.get("done_total").inc(800.0, reason="length")
+        hl, hr, hc = (
+            r.get("lat_ms") for r in (left, right, combined)
+        )
+        assert hl.count() == hr.count() == 1200
+        for q in (0.5, 0.95, 0.99):
+            assert hl.quantile(q) == hr.quantile(q) == hc.quantile(q)
+        # counters add; gauges are last-writer-wins
+        assert left.get("done_total").total() == 1200.0
+        assert left.get("depth").value() == 3.0
+        assert right.get("depth").value() == 3.0
+
+    def test_merged_quantiles_reproduce_combined_stream(self):
+        """The acceptance bar: merging per-replica snapshots and then
+        asking for a percentile answers within the error bound of the
+        percentile of the CONCATENATED raw streams."""
+        (ra, sa), (rb, sb) = self._filled(11, 900), self._filled(12, 700)
+        merged = MetricRegistry()
+        merged.merge_from(ra)
+        merged.merge_from(rb)
+        h = merged.get("lat_ms")
+        raw = np.concatenate([sa, sb])
+        for q in (0.5, 0.95):
+            true = float(np.percentile(raw, 100 * q))
+            assert abs(h.quantile(q) - true) / true <= h.error_bound
+
+    def test_mismatched_buckets_refuse_to_merge(self):
+        a, b = MetricRegistry(), MetricRegistry()
+        a.histogram("lat_ms", buckets=(1.0, 2.0))
+        b.histogram("lat_ms", buckets=(1.0, 3.0))
+        with pytest.raises(ValueError, match="bucket"):
+            a.merge_from(b)
+
+
+# ---------------------------------------------------------------------------
+# exposition + exporter round-trip
+# ---------------------------------------------------------------------------
+
+
+def _parse_exposition(text):
+    """{name: {(label_tuple): value}} plus HELP/TYPE maps — a tiny
+    strict parser of the 0.0.4 text format."""
+    series, helps, types = {}, {}, {}
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            _, _, name, h = line.split(" ", 3)
+            helps[name] = h
+        elif line.startswith("# TYPE "):
+            _, _, name, t = line.split(" ", 3)
+            types[name] = t
+        elif line:
+            head, val = line.rsplit(" ", 1)
+            series.setdefault(head, 0.0)
+            series[head] = float(val)
+    return series, helps, types
+
+
+class TestExposition:
+    def test_prometheus_text_format(self):
+        reg = MetricRegistry()
+        c = reg.counter("done_total", "finished requests",
+                        labelnames=("reason",))
+        c.inc(3.0, reason="length")
+        h = reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        series, helps, types = _parse_exposition(reg.exposition())
+        assert types["done_total"] == "counter"
+        assert types["lat_ms"] == "histogram"
+        assert helps["done_total"] == "finished requests"
+        assert series['done_total{reason="length"}'] == 3.0
+        # cumulative buckets, monotone, +Inf == count
+        b1 = series['lat_ms_bucket{le="1"}']
+        b10 = series['lat_ms_bucket{le="10"}']
+        binf = series['lat_ms_bucket{le="+Inf"}']
+        assert (b1, b10, binf) == (1.0, 2.0, 3.0)
+        assert series["lat_ms_count"] == 3.0
+        assert series["lat_ms_sum"] == pytest.approx(55.5)
+
+    def test_label_escaping(self):
+        reg = MetricRegistry()
+        reg.counter("x_total", labelnames=("k",)).inc(k='a"b\\c\nd')
+        text = reg.exposition()
+        assert 'k="a\\"b\\\\c\\nd"' in text
+
+
+class TestExporter:
+    def _get(self, port, path):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.getheader("Content-Type"), resp.read()
+        finally:
+            conn.close()
+
+    def test_scrape_round_trip_on_ephemeral_port(self):
+        reg = MetricRegistry()
+        reg.histogram("lat_ms", buckets=(1.0, 10.0)).observe(3.0)
+        health = {"healthy": True, "draining": False}
+        mon = SLOMonitor(registry=reg)
+        with TelemetryServer(
+            reg, health_fn=lambda: health, slo_monitor=mon
+        ) as srv:
+            assert srv.port > 0
+            status, ctype, body = self._get(srv.port, "/metrics")
+            assert status == 200 and ctype == PROMETHEUS_CONTENT_TYPE
+            series, _, types = _parse_exposition(body.decode())
+            assert types["lat_ms"] == "histogram"
+            assert series["lat_ms_count"] == 1.0
+            # /healthz flips 200 -> 503 with the health report
+            status, _, body = self._get(srv.port, "/healthz")
+            assert status == 200 and json.loads(body)["healthy"]
+            health["healthy"] = False
+            status, _, body = self._get(srv.port, "/healthz")
+            assert status == 503 and not json.loads(body)["healthy"]
+            # /varz carries the snapshot + slo status
+            status, ctype, body = self._get(srv.port, "/varz")
+            assert status == 200 and ctype == "application/json"
+            varz = json.loads(body)
+            assert "lat_ms" in varz["metrics"]
+            assert "slo" in varz and "device_memory" in varz
+            status, _, _ = self._get(srv.port, "/nope")
+            assert status == 404
+        srv.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates (synthetic clock — no wall time)
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def test_validation(self):
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms")
+        with pytest.raises(ValueError, match="objective"):
+            SLO("x", 1.5, series=h, threshold=10.0)
+        with pytest.raises(ValueError, match="threshold"):
+            SLO("x", 0.9, series=h)
+        with pytest.raises(ValueError, match="exactly one"):
+            SLO("x", 0.9)
+        with pytest.raises(ValueError):
+            BurnRule(10.0, 60.0, 2.0)  # short >= long
+
+    def test_burn_rate_math_on_synthetic_series(self):
+        """bad_rate / budget over the window: 30% bad against a 10%
+        budget is a burn of 3 on both windows -> firing; the clean
+        tail clears it."""
+        reg = MetricRegistry()
+        good = reg.counter("good_total")
+        total = reg.counter("all_total")
+        tracer = Tracer()
+        mon = SLOMonitor(registry=reg, tracer=tracer)
+        slo = mon.add(SLO(
+            "avail", 0.9, good=good, total=total,
+            windows=(BurnRule(60.0, 15.0, 2.0),),
+        ))
+        mon.tick(now=0.0)
+        # 10 events/s, 30% bad for 30s
+        for t in range(1, 31):
+            total.inc(10.0)
+            good.inc(7.0)
+            mon.tick(now=float(t))
+        rates = mon.burn_rates(slo, now=30.0)[0]
+        assert rates["burn_long"] == pytest.approx(3.0)
+        assert rates["burn_short"] == pytest.approx(3.0)
+        firing = mon.alerts(now=30.0)
+        assert [f["slo"] for f in firing] == ["avail"]
+        assert len(mon.events) == 1
+        assert reg.get("slo_alerts_total").value(slo="avail") == 1.0
+        assert any(
+            "slo_alert:avail" in str(e) for e in tracer.events()
+        )
+        # continued firing is NOT a new rising edge
+        total.inc(10.0)
+        good.inc(7.0)
+        mon.tick(now=31.0)
+        mon.alerts(now=31.0)
+        assert len(mon.events) == 1
+        # a clean 60s washes the windows out -> clears
+        for t in range(32, 92):
+            total.inc(10.0)
+            good.inc(10.0)
+            mon.tick(now=float(t))
+        assert mon.alerts(now=91.0) == []
+        # and a second burst is a SECOND rising edge
+        for t in range(92, 122):
+            total.inc(10.0)
+            good.inc(5.0)
+            mon.tick(now=float(t))
+        mon.alerts(now=121.0)
+        assert len(mon.events) == 2
+        assert reg.get("slo_alerts_total").value(slo="avail") == 2.0
+
+    def test_quiet_series_never_fires(self):
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms")
+        mon = SLOMonitor(registry=reg)
+        mon.add(SLO("ttft", 0.9, series=h, threshold=100.0,
+                    windows=(BurnRule(60.0, 15.0, 2.0),)))
+        mon.tick(now=0.0)
+        rng = np.random.RandomState(0)
+        for t in range(1, 120):
+            # 5% of observations over threshold: half the budget
+            h.observe(500.0 if rng.rand() < 0.05 else 10.0)
+            mon.tick(now=float(t))
+            mon.alerts(now=float(t))
+        assert mon.events == []
+
+    def test_latency_slo_reads_histogram(self):
+        reg = MetricRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0, 10.0, 100.0))
+        for v in (5.0, 5.0, 50.0, 500.0):
+            h.observe(v)
+        slo = SLO("ttft", 0.9, series=h, threshold=10.0)
+        good, total = slo.read()
+        assert (good, total) == (2.0, 4.0)
+
+    def test_windows_degrade_to_oldest_sample(self):
+        """A window longer than the collected history differences
+        against the oldest sample instead of returning None — partial
+        windows still alert (second-scale bench rules rely on it)."""
+        reg = MetricRegistry()
+        good = reg.counter("g_total")
+        total = reg.counter("t_total")
+        mon = SLOMonitor(registry=reg)
+        slo = mon.add(SLO(
+            "avail", 0.9, good=good, total=total,
+            windows=(BurnRule(3600.0, 300.0, 2.0),),
+        ))
+        mon.tick(now=0.0)
+        for t in (1.0, 2.0, 3.0):
+            total.inc(10.0)
+            good.inc(6.0)
+            mon.tick(now=t)
+        rates = mon.burn_rates(slo, now=3.0)[0]
+        assert rates["burn_long"] == pytest.approx(4.0)
+        assert rates["firing"]
+
+
+# ---------------------------------------------------------------------------
+# engine stats() on the registry (compile-cache-hit shapes)
+# ---------------------------------------------------------------------------
+
+
+def fp32_cfg(**kw):
+    kw.setdefault("vocab_size", 96)
+    kw.setdefault("hidden_size", 32)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("max_position_embeddings", 32)
+    kw.setdefault("hidden_dropout", 0.0)
+    kw.setdefault("attention_dropout", 0.0)
+    kw.setdefault("tensor_parallel_size", 1)
+    kw.setdefault("params_dtype", jnp.float32)
+    kw.setdefault("dtype", jnp.float32)
+    return GPTConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = fp32_cfg()
+    model = GPTModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )
+    return model, params
+
+
+def greedy_engine(model, params, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("capacity", 24)
+    kw.setdefault("prefill_token_budget", 4)
+    kw.setdefault("sampling", SamplingParams(temperature=0.0))
+    return InferenceEngine(model, params, **kw)
+
+
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+
+
+class TestEngineStats:
+    def test_stats_schema_and_histogram_parity(self, small_model):
+        """The rewritten stats() keeps its public schema, the registry
+        histograms agree with the raw rings within the documented
+        error bound, and the completion counters balance."""
+        model, params = small_model
+        eng = greedy_engine(model, params)
+        results = eng.generate(PROMPTS, max_new_tokens=3)
+        s = eng.stats()
+        for key in (
+            "queue_depth", "slots_active", "admitted", "evicted",
+            "prompt_tokens", "generated_tokens", "queue_wait_ms_p50",
+            "queue_wait_ms_p95", "ttft_ms_p50", "ttft_ms_p95",
+        ):
+            assert key in s, key
+        reg = eng.registry
+        h_ttft = reg.get("serve_ttft_ms")
+        raw_ttft = [c["ttft_ms"] for c in eng.completions]
+        assert h_ttft.count() == len(raw_ttft) == len(PROMPTS)
+        for q in (50, 95):
+            true = float(np.percentile(raw_ttft, q))
+            est = h_ttft.percentile(q)
+            assert abs(est - true) / max(true, 1e-9) <= h_ttft.error_bound
+        # completion accounting: counters == records == results
+        c_done = reg.get("serve_completions_total")
+        assert c_done.total() == len(results)
+        assert c_done.value(finish_reason="length") == len(results)
+        c_tok = reg.get("serve_tokens_total")
+        assert c_tok.value(phase="generated") == sum(
+            len(r.tokens) for r in results
+        )
+        assert c_tok.value(phase="prompt") == sum(
+            len(p) for p in PROMPTS
+        )
+
+    def test_retention_cap_and_histogram_fallback(self, small_model):
+        """stats_retention bounds the raw rings; once traffic exceeds
+        the cap the percentiles come from the histogram (which still
+        holds EVERY observation) instead of the truncated ring."""
+        model, params = small_model
+        eng = greedy_engine(model, params, stats_retention=2)
+        eng.generate(PROMPTS, max_new_tokens=3)
+        assert len(eng.completions) == 2  # ring capped
+        h = eng.registry.get("serve_ttft_ms")
+        assert h.count() == len(PROMPTS)  # histogram saw everything
+        s = eng.stats()
+        assert s["ttft_ms_p95"] == pytest.approx(h.percentile(95))
+        with pytest.raises(ValueError):
+            greedy_engine(model, params, stats_retention=0)
+
+    def test_null_registry_engine_keeps_ring_stats(self, small_model):
+        model, params = small_model
+        eng = greedy_engine(model, params, registry=NULL_REGISTRY)
+        eng.generate(PROMPTS, max_new_tokens=3)
+        s = eng.stats()
+        raw = [c["ttft_ms"] for c in eng.completions]
+        assert s["ttft_ms_p95"] == pytest.approx(
+            float(np.percentile(raw, 95)), rel=1e-6
+        )
+        assert NULL_REGISTRY.families() == []
+
+    def test_reset_stats_clears_registry_families(self, small_model):
+        model, params = small_model
+        eng = greedy_engine(model, params)
+        eng.generate(PROMPTS, max_new_tokens=3)
+        assert eng.registry.get("serve_ttft_ms").count() > 0
+        eng.reset_stats()
+        assert eng.registry.get("serve_ttft_ms").count() == 0.0
+        assert eng.registry.get("serve_completions_total").total() == 0.0
+        assert eng.completions == []
+
+
+# ---------------------------------------------------------------------------
+# tracer drop counter + RegistryWriter sink
+# ---------------------------------------------------------------------------
+
+
+class TestTracerDrops:
+    def test_ring_wrap_is_counted_and_exported(self, tmp_path):
+        reg = MetricRegistry()
+        t = Tracer(capacity=4, registry=reg)
+        for i in range(7):
+            t.instant(f"e{i}", ts=float(i))
+        assert t.dropped == 3
+        assert reg.get(
+            "tracer_dropped_events_total"
+        ).total() == 3.0
+        path = tmp_path / "trace.json"
+        t.export_chrome_trace(str(path))
+        other = json.loads(path.read_text())["otherData"]
+        assert other["dropped_events"] == 3
+        assert "incomplete" in other["warning"]
+
+    def test_no_drops_no_warning(self, tmp_path):
+        t = Tracer(capacity=16)
+        t.instant("e", ts=0.0)
+        path = tmp_path / "trace.json"
+        t.export_chrome_trace(str(path))
+        other = json.loads(path.read_text())["otherData"]
+        assert other["dropped_events"] == 0
+        assert "warning" not in other
+
+
+class TestRegistryWriter:
+    def test_training_scalars_land_in_registry(self):
+        reg = MetricRegistry()
+        w = RegistryWriter(reg)
+        w.write(3, {"loss": 2.5, "step_time_ms": 120.0,
+                    "grad-norm": 1.0})
+        assert reg.get("train_step").value() == 3.0
+        assert reg.get("train_loss").value() == 2.5
+        assert reg.get("train_grad_norm").value() == 1.0  # sanitized
+        assert reg.get("train_step_ms").count() == 1.0
+        w.write(4, {"loss": 2.0, "step_time_ms": 100.0})
+        assert reg.get("train_step").value() == 4.0  # gauge: latest
+        assert reg.get("train_step_ms").count() == 2.0

@@ -155,6 +155,62 @@ def test_generate_gpt_sigterm_drains_gracefully():
     assert "(cancelled)" in out or "(length)" in out
 
 
+def test_generate_gpt_metrics_endpoint_mid_run():
+    """--metrics-port 0: the telemetry exporter serves /metrics and
+    /healthz WHILE the serving loop runs (scraped here over a real
+    HTTP connection on the ephemeral port the script prints), and at
+    exit the script's own accounting check ties the registry counters
+    to the delivered results ('consistent' line, ISSUE 14)."""
+    import http.client
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, str(REPO / "examples" / "generate_gpt.py"),
+            "--num-layers", "2", "--hidden-size", "64",
+            "--num-attention-heads", "4", "--max-seq-len", "64",
+            "--max-prompt-len", "12", "--num-slots", "2",
+            "--num-requests", "16", "--max-new-tokens", "12",
+            "--token-budget", "5", "--metrics-port", "0",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=str(REPO),
+        env=ENV,
+    )
+    try:
+        port = None
+        for line in proc.stdout:
+            if line.startswith("metrics: http://127.0.0.1:"):
+                port = int(line.rsplit(":", 1)[1])
+                break
+        else:
+            pytest.fail("generate_gpt.py exited before its metrics line")
+        # the exporter is up before the loop starts — scrape it while
+        # the engine is (or is about to start) serving
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert b"serve_" in body  # the engine families are registered
+        conn.request("GET", "/healthz")
+        hz = conn.getresponse()
+        hz_body = hz.read()
+        assert hz.status == 200, hz_body
+        conn.close()
+        out, _ = proc.communicate(timeout=600)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, f"exit {proc.returncode}\n{out[-2000:]}"
+    # the script's completion-accounting check: registry counters ==
+    # delivered results == stats()
+    assert "(consistent)" in out, out[-2000:]
+
+
 # slow: three full subprocess runs (~45 s) — excluded from the tier-1
 # gate per the marker's charter (pyproject.toml) to keep the suite
 # inside its hard wall-clock budget; deeper CI tiers and `-m slow`

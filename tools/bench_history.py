@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Bench-round trajectory: diff the newest BENCH_r*.json against the
+previous round and gate on headline-throughput regressions.
+
+The driver snapshots every bench invocation into ``BENCH_rNN.json``
+(``{"n", "cmd", "rc", "tail", "parsed"}`` — ``tail`` carries the
+jsonl metric lines the shared ``_report`` contract printed, one
+``{"metric", "value", "unit", "vs_baseline"}`` object per line;
+``parsed`` is the last of them). Those snapshots accumulate but
+nothing reads them back — a slow regression across rounds is
+invisible until someone eyeballs the numbers. This tool is the
+read-back:
+
+* parses EVERY metric line from every round's tail (not just the last
+  — a serve round emits tokens/s and ttft lines together), falling
+  back to ``parsed`` when the tail carries none;
+* prints a metric x round trajectory table (newest last) with the
+  round-over-round delta for the newest value;
+* exits nonzero when a GUARDED metric (default: the two headline
+  per-chip throughputs, ``gpt_train_tokens_per_sec_per_chip`` and
+  ``gpt_serve_tokens_per_sec_per_chip``) drops more than
+  ``--threshold`` (default 10%) between its two most recent
+  appearances. Rounds that didn't run a guarded bench don't trip the
+  gate (the diff pairs the last two rounds that DID); ``--warn-only``
+  downgrades the failure to a warning for exploratory rounds.
+
+Usage (from the repo root, part of the tier-1 flow in ROADMAP.md):
+
+    python tools/bench_history.py [--dir .] [--threshold 0.10]
+        [--warn-only] [--guard METRIC ...]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+DEFAULT_GUARDS = (
+    "gpt_train_tokens_per_sec_per_chip",
+    "gpt_serve_tokens_per_sec_per_chip",
+)
+
+
+def load_rounds(bench_dir):
+    """[(round_n, {metric: value})] sorted by round, skipping files
+    that don't parse (a half-written snapshot must not kill the
+    gate)."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_history: skipping {path}: {e}", file=sys.stderr)
+            continue
+        metrics = {}
+        for line in d.get("tail", "").splitlines():
+            line = line.strip()
+            if not line.startswith('{"metric"'):
+                continue
+            try:
+                m = json.loads(line)
+            except ValueError:
+                continue
+            if "metric" in m and "value" in m:
+                metrics[m["metric"]] = float(m["value"])
+        if not metrics and isinstance(d.get("parsed"), dict):
+            p = d["parsed"]
+            if "metric" in p and "value" in p:
+                metrics[p["metric"]] = float(p["value"])
+        rounds.append((int(d.get("n", len(rounds) + 1)), metrics))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def trajectory_table(rounds):
+    """Metric x round table, newest round last; '-' where a round
+    didn't emit the metric."""
+    names = []
+    for _, metrics in rounds:
+        for name in metrics:
+            if name not in names:
+                names.append(name)
+    if not names:
+        return "  (no metric lines found in any round)"
+    head = ["metric".ljust(44)] + [f"r{n:02d}".rjust(10) for n, _ in rounds]
+    lines = ["  " + " ".join(head)]
+    for name in names:
+        row = [name.ljust(44)]
+        for _, metrics in rounds:
+            v = metrics.get(name)
+            row.append(("-" if v is None else f"{v:.1f}").rjust(10))
+        lines.append("  " + " ".join(row))
+    return "\n".join(lines)
+
+
+def last_two(rounds, metric):
+    """The two most recent (round_n, value) appearances of a metric,
+    or None when it has appeared fewer than twice."""
+    hits = [(n, m[metric]) for n, m in rounds if metric in m]
+    if len(hits) < 2:
+        return None
+    return hits[-2], hits[-1]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--dir", default=os.path.join(os.path.dirname(__file__), ".."),
+        help="directory holding BENCH_r*.json (default: repo root)",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="max tolerated fractional drop in a guarded metric "
+             "between its two most recent rounds (default 0.10)",
+    )
+    ap.add_argument(
+        "--warn-only", action="store_true",
+        help="report regressions but exit 0 (exploratory rounds)",
+    )
+    ap.add_argument(
+        "--guard", action="append", default=None, metavar="METRIC",
+        help="metric to gate (repeatable; default: "
+             + ", ".join(DEFAULT_GUARDS) + ")",
+    )
+    args = ap.parse_args(argv)
+    guards = tuple(args.guard) if args.guard else DEFAULT_GUARDS
+
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(f"bench_history: no BENCH_r*.json under {args.dir}",
+              file=sys.stderr)
+        return 2
+
+    print(f"bench trajectory ({len(rounds)} rounds):")
+    print(trajectory_table(rounds))
+
+    failed = []
+    for metric in guards:
+        pair = last_two(rounds, metric)
+        if pair is None:
+            print(f"guard {metric}: <2 appearances — nothing to diff")
+            continue
+        (n0, v0), (n1, v1) = pair
+        delta = (v1 - v0) / v0 if v0 else 0.0
+        status = "ok"
+        if delta < -args.threshold:
+            status = "REGRESSION"
+            failed.append((metric, n0, n1, delta))
+        print(
+            f"guard {metric}: r{n0:02d} {v0:.1f} -> r{n1:02d} {v1:.1f} "
+            f"({delta:+.1%}) {status}"
+        )
+    if failed:
+        for metric, n0, n1, delta in failed:
+            print(
+                f"bench_history: {metric} regressed {delta:.1%} "
+                f"(r{n0:02d} -> r{n1:02d}, threshold "
+                f"-{args.threshold:.0%})",
+                file=sys.stderr,
+            )
+        if not args.warn_only:
+            return 1
+        print("bench_history: --warn-only set; exiting 0",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
