@@ -346,7 +346,7 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
                 page_size: int = 0, kv_dtype: str = "",
                 shared_prefix: bool = False, spec_k: int = -1,
                 chaos: int = -1, slo: bool = False,
-                metrics_port: int = -1):
+                metrics_port: int = -1, replicas: int = 0):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -415,6 +415,25 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     telemetry exporter over the measured engine's registry on
     127.0.0.1:N (0 = ephemeral) and self-scrapes ``/metrics`` and
     ``/healthz`` once before exiting.
+
+    ``--replicas=N`` runs the multi-replica fabric
+    (`inference.ReplicaRouter`, N >= 2) on the mixed workload and
+    reports ``gpt_serve_fleet_tokens_per_sec`` (vs_baseline = fleet
+    rate / a single-replica run measured in the same invocation).
+    Greedy fleet tokens are asserted bitwise-identical to the
+    single-replica reference (placement must never change outputs).
+    Composed with ``--chaos=SEED`` the fleet pass runs again under a
+    seed-derived replica fault plan (a ``replica_kill`` mid-decode
+    plus a ``replica_slow`` latency injection) and asserts the
+    ISSUE-15 survival identity: every submitted request accounted
+    exactly ONCE, every recovered request's tokens bitwise-identical
+    to the undisturbed reference (no token emitted twice), the killed
+    replica's pages/slots provably clean after quarantine, each
+    replica's mixed step still traced once, and the merged fleet
+    registry's TTFT histogram reproducing the combined per-replica
+    completion streams. ``--metrics-port=N`` here stands the exporter
+    up over the ROUTER (zero-arg merged-registry provider, fleet
+    `/healthz`) and self-scrapes it.
 
     ``--spec-k=K`` A/Bs speculative decoding (n-gram self-drafting
     through the mixed step, `inference/drafting.py`) against the
@@ -703,6 +722,169 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
             )
         finally:
             srv.close()
+
+    if replicas >= 2:
+        from rocm_apex_tpu.inference import Fault, FaultPlan, ReplicaRouter
+
+        ekw = dict(
+            num_slots=num_slots, capacity=capacity,
+            max_prompt_len=max(lens),
+            sampling=SamplingParams(temperature=0.0), seed=0,
+            prefill_token_budget=budget,
+        )
+        if paged:
+            ekw.update(
+                paged=True,
+                page_size=page_size or (64 if on_tpu else 16),
+                kv_dtype=jnp.int8 if kv_dtype == "int8" else None,
+            )
+
+        # the undisturbed single-replica run is BOTH the rate baseline
+        # and the token-parity anchor: placement and recovery must
+        # never change greedy outputs
+        eng_ref, res_ref, rate_ref, _ = run(True)
+        ref_tokens = [r.tokens for r in res_ref]
+        assert eng_ref.mixed_trace_count == 1
+
+        def run_fleet(plan):
+            router = ReplicaRouter(
+                model, params, replicas=replicas,
+                engine_kwargs=dict(ekw), faults=plan,
+            )
+            # per-replica compile warmup (the router's tick counter
+            # stays 0, so seeded fault ticks land in the timed window)
+            for i in range(router.num_replicas):
+                router.replica(i).generate(
+                    prompts[:num_slots], max_new_tokens=3
+                )
+                router.replica(i).reset_stats()
+            t0 = time.perf_counter()
+            results = router.generate(prompts, max_new_tokens=max_new)
+            dt = time.perf_counter() - t0
+            gen = sum(len(r.tokens) for r in results)
+            return router, results, gen / dt, dt
+
+        def check_fleet(router, results, label):
+            # the ISSUE-15 survival identity, asserted on every fleet
+            # pass (clean and chaotic alike)
+            assert [r.tokens for r in results] == ref_tokens, (
+                f"{label}: fleet tokens diverged from the "
+                f"single-replica reference"
+            )
+            rids = [r.request_id for r in results]
+            assert len(results) == n_requests == len(set(rids)), (
+                f"{label}: {n_requests} submitted, {len(results)} "
+                f"delivered ({len(set(rids))} unique)"
+            )
+            s = router.stats()
+            assert s["completed"] == s["submitted"] == n_requests, s
+            for i in range(router.num_replicas):
+                rep = router.replica(i)
+                assert rep.mixed_trace_count == 1, (
+                    f"{label}: replica {i} traced the mixed step "
+                    f"{rep.mixed_trace_count}x"
+                )
+                assert rep.num_active == 0 and rep.pages_used == 0, (
+                    f"{label}: replica {i} leaked slots/pages"
+                )
+                if paged:
+                    rep._allocator.assert_consistent()
+            # the merged scrape reproduces the combined per-replica
+            # completion streams (bucket adds are exact)
+            merged = router.merged_registry().get("serve_ttft_ms")
+            per_rep = sum(
+                router.replica(i).registry.get("serve_ttft_ms").count()
+                for i in range(router.num_replicas)
+            )
+            assert merged.count() == per_rep == n_requests, (
+                f"{label}: merged ttft count {merged.count()} != "
+                f"sum of replicas {per_rep} != {n_requests}"
+            )
+            return s
+
+        router_f, res_f, rate_f, dt_f = run_fleet(None)
+        s_f = check_fleet(router_f, res_f, f"fleet x{replicas}")
+        survival = "clean pass"
+        if chaos >= 0:
+            # seed-derived replica fault plan: one mid-decode kill plus
+            # one slow-replica injection — replays bit-for-bit from the
+            # same command line
+            rng_c = np.random.RandomState(chaos)
+            victim = int(rng_c.randint(0, replicas))
+            plan = FaultPlan([
+                Fault(site="replica_kill",
+                      tick=int(rng_c.randint(3, 8)),
+                      payload={"replica": victim}),
+                Fault(site="replica_slow",
+                      tick=int(rng_c.randint(8, 12)),
+                      payload={"replica": (victim + 1) % replicas,
+                               "seconds": 0.001}),
+            ], seed=chaos)
+            router_c, res_c, _, _ = run_fleet(plan)
+            s_c = check_fleet(router_c, res_c, f"chaos seed={chaos}")
+            assert plan.fires.get("replica_kill", 0) == 1, (
+                f"replica_kill never fired: {dict(plan.fires)}"
+            )
+            assert s_c["replica_kills"] >= 1, s_c
+            assert s_c["migrations"] >= 1, (
+                "kill mid-decode migrated no in-flight work"
+            )
+            survival = (
+                f"chaos seed={chaos}: killed replica {victim}, "
+                f"{int(s_c['migrations'])} migrations, "
+                f"{int(s_c['replica_rejoins'])} rejoins — recovered "
+                f"tokens bitwise-identical, no request lost or "
+                f"double-delivered, killed replica's slots/pages clean"
+            )
+        if metrics_port >= 0:
+            # fleet exporter: zero-arg merged-registry provider + the
+            # fleet /healthz (503 only when NO replica is healthy)
+            import http.client
+            import json as _json
+
+            srv = monitor.start_exporter(
+                router=router_f, port=metrics_port
+            )
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=10
+                )
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 200, resp.status
+                assert b"serve_ttft_ms_count" in body
+                assert b"router_events_total" in body
+                conn.request("GET", "/healthz")
+                hz = conn.getresponse()
+                healthy = _json.loads(hz.read()).get("healthy")
+                assert hz.status == 200 and healthy, (hz.status, healthy)
+                conn.close()
+                print(
+                    f"serve fleet metrics: {srv.url} — /metrics "
+                    f"{len(body)} bytes (merged per scrape), /healthz "
+                    f"200 with {int(s_f['healthy_replicas'])} healthy",
+                    file=sys.stderr,
+                )
+            finally:
+                srv.close()
+        print(
+            f"serve[fleet x{replicas}{'/paged' if paged else ''}]: "
+            f"{rate_f:.1f} gen tok/s over {dt_f:.2f}s vs 1-replica "
+            f"{rate_ref:.1f} ({rate_f / rate_ref:.2f}x); tokens "
+            f"identical to the single-replica reference; {survival}",
+            file=sys.stderr,
+        )
+        _report(
+            "gpt_serve_fleet_tokens_per_sec", rate_f, "tokens/s",
+            rate_f / rate_ref,
+            f"{replicas}-replica ReplicaRouter vs single replica "
+            f"{rate_ref:.1f} tok/s (ratio = vs_baseline); every "
+            f"request accounted exactly once, fleet tokens "
+            f"bitwise-identical to the 1-replica reference, merged "
+            f"/metrics ttft count == sum of replicas; {survival}",
+        )
+        return
 
     if chaos >= 0:
         from rocm_apex_tpu.inference import FINISH_REASONS, Fault, FaultPlan
@@ -2103,6 +2285,8 @@ if __name__ == "__main__":
             kwargs["slo"] = True
         elif a.startswith("--metrics-port="):
             kwargs["metrics_port"] = int(a.split("=", 1)[1])
+        elif a.startswith("--replicas="):
+            kwargs["replicas"] = int(a.split("=", 1)[1])
         elif a == "--dist-opt":
             kwargs["dist_opt"] = True
         elif a.startswith("--comm-dtype="):
@@ -2145,12 +2329,12 @@ if __name__ == "__main__":
         or "page_size" in kwargs or "kv_dtype" in kwargs
         or "shared_prefix" in kwargs or "spec_k" in kwargs
         or "chaos" in kwargs or "slo" in kwargs
-        or "metrics_port" in kwargs
+        or "metrics_port" in kwargs or "replicas" in kwargs
     ) and which != "serve":
         raise SystemExit(
             "--budget/--whole-prompt/--trace/--paged/--page-size/"
             "--kv-dtype/--shared-prefix/--spec-k/--chaos/--slo/"
-            "--metrics-port apply to the serve bench"
+            "--metrics-port/--replicas apply to the serve bench"
         )
     if kwargs.get("spec_k", 0) < 0:
         raise SystemExit("--spec-k must be >= 0")
@@ -2158,9 +2342,23 @@ if __name__ == "__main__":
         raise SystemExit("--chaos takes a seed >= 0")
     if kwargs.get("metrics_port", 0) < 0:
         raise SystemExit("--metrics-port takes a port >= 0 (0 = ephemeral)")
+    if kwargs.get("replicas", 2) < 2:
+        raise SystemExit("--replicas takes a fleet size N >= 2")
+    if "replicas" in kwargs and (
+        kwargs.get("whole_prompt") or kwargs.get("shared_prefix")
+        or "spec_k" in kwargs or kwargs.get("slo")
+    ):
+        raise SystemExit(
+            "--replicas runs the fleet pass on the mixed workload; it "
+            "composes with --chaos/--paged/--metrics-port, not with "
+            "--whole-prompt/--shared-prefix/--spec-k/--slo"
+        )
     if ("slo" in kwargs or "metrics_port" in kwargs) and (
         kwargs.get("shared_prefix") or "spec_k" in kwargs
-        or (kwargs.get("paged") and "chaos" not in kwargs)
+        or (
+            kwargs.get("paged") and "chaos" not in kwargs
+            and "replicas" not in kwargs
+        )
     ):
         raise SystemExit(
             "--slo/--metrics-port instrument the mixed-workload serve "

@@ -82,6 +82,13 @@ def main():
                    help="optional cap on tokens taken from ONE "
                         "request per tick (fairness inside the budget)")
     p.add_argument("--num-slots", type=int, default=2)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through a ReplicaRouter fleet of N "
+                        "identical engines (N >= 2): prefix-affinity "
+                        "+ least-loaded placement, failover with "
+                        "token-identical recovery, rolling drain; "
+                        "needs a token budget (migration recomputes "
+                        "through chunked prefill); 1 = single engine")
     p.add_argument("--num-requests", type=int, default=6)
     p.add_argument("--max-new-tokens", type=int, default=8)
     p.add_argument("--spec-k", type=int, default=0,
@@ -136,8 +143,7 @@ def main():
           flush=True)
 
     tracer = Tracer(enabled=args.trace is not None)
-    eng = InferenceEngine(
-        model, params,
+    engine_kwargs = dict(
         num_slots=args.num_slots,
         max_prompt_len=args.max_prompt_len,
         capacity=args.max_seq_len,
@@ -152,14 +158,45 @@ def main():
         tracer=tracer,
         spec_k=args.spec_k,
     )
+    router = None
+    if args.replicas >= 2:
+        if not chunked:
+            raise SystemExit(
+                "--replicas needs --token-budget > 0: replica "
+                "failover recomputes migrated requests through the "
+                "chunked prefill"
+            )
+        if args.trace is not None or args.spec_k > 0:
+            raise SystemExit(
+                "--replicas does not compose with --trace/--spec-k "
+                "in this example (single-engine instrumentation)"
+            )
+        from rocm_apex_tpu.inference import ReplicaRouter
+
+        router = ReplicaRouter(
+            model, params, replicas=args.replicas,
+            engine_kwargs=engine_kwargs,
+        )
+        serve = router
+        print(f"fleet: {args.replicas} replicas behind one router",
+              flush=True)
+    else:
+        serve = eng = InferenceEngine(model, params, **engine_kwargs)
 
     exporter = None
     if args.metrics_port is not None:
         from rocm_apex_tpu.monitor import start_exporter
 
-        exporter = start_exporter(
-            eng.registry, port=args.metrics_port, engine=eng
-        )
+        if router is not None:
+            # merged-per-scrape registry + fleet /healthz (503 only
+            # when no replica is healthy); replica detail on /varz
+            exporter = start_exporter(
+                router=router, port=args.metrics_port
+            )
+        else:
+            exporter = start_exporter(
+                eng.registry, port=args.metrics_port, engine=eng
+            )
         # flush: the L1 smoke scrapes this address mid-run
         print(f"metrics: {exporter.url}", flush=True)
 
@@ -172,17 +209,17 @@ def main():
 
     t0 = time.perf_counter()
     for prompt in prompts:
-        eng.add_request(prompt, args.max_new_tokens)
+        serve.add_request(prompt, args.max_new_tokens)
     results = []
     drained = False
-    while eng.has_work():
+    while serve.has_work():
         if stop.is_set():
             # SIGTERM: shed the queue, let in-flight requests finish,
             # exit 0 — never kill a request mid-token
-            results.extend(eng.drain(shed_queue=True))
+            results.extend(serve.drain(shed_queue=True))
             drained = True
             break
-        results.extend(eng.step())
+        results.extend(serve.step())
     results.sort(key=lambda r: r.request_id)
     dt = time.perf_counter() - t0
 
@@ -195,13 +232,27 @@ def main():
     for r in results:
         print(f"req {r.request_id}: prompt[{len(r.prompt)}] -> "
               f"{r.tokens} ({r.finish_reason})")
-    s = eng.stats()
-    print(f"generated {n_gen} tokens across {len(results)} requests "
-          f"in {dt:.2f}s ({n_gen / dt:.1f} tok/s) | "
-          f"ttft p50/p95={s['ttft_ms_p50']:.0f}/{s['ttft_ms_p95']:.0f}ms | "
-          f"traces: mixed={eng.mixed_trace_count} "
-          f"decode={eng.decode_trace_count} "
-          f"prefill={eng.prefill_trace_count}")
+    s = serve.stats()
+    if router is not None:
+        hist = router.merged_registry().get("serve_ttft_ms")
+        traces = [
+            router.replica(i).mixed_trace_count
+            for i in range(router.num_replicas)
+        ]
+        print(f"generated {n_gen} tokens across {len(results)} "
+              f"requests in {dt:.2f}s ({n_gen / dt:.1f} tok/s) | "
+              f"ttft p50/p95={hist.percentile(50):.0f}/"
+              f"{hist.percentile(95):.0f}ms (merged fleet) | "
+              f"migrations={s['migrations']:.0f} "
+              f"quarantines={s['replica_quarantines']:.0f} | "
+              f"traces: mixed={traces} (one per replica)")
+    else:
+        print(f"generated {n_gen} tokens across {len(results)} requests "
+              f"in {dt:.2f}s ({n_gen / dt:.1f} tok/s) | "
+              f"ttft p50/p95={s['ttft_ms_p50']:.0f}/{s['ttft_ms_p95']:.0f}ms | "
+              f"traces: mixed={eng.mixed_trace_count} "
+              f"decode={eng.decode_trace_count} "
+              f"prefill={eng.prefill_trace_count}")
     if args.spec_k > 0:
         print(f"speculative: k={args.spec_k} "
               f"drafted={s['tokens_drafted']:.0f} "
@@ -212,13 +263,34 @@ def main():
         # completion accounting: the registry counters, the delivered
         # results, and stats() must tell one story (the L1 smoke
         # asserts this line says "consistent")
-        c_done = eng.registry.get("serve_completions_total").total()
-        c_gen = eng.registry.get(
+        reg = (
+            router.merged_registry() if router is not None
+            else eng.registry
+        )
+        c_done = reg.get("serve_completions_total").total()
+        c_gen = reg.get(
             "serve_tokens_total"
         ).value(phase="generated")
-        ok_acct = c_done == len(results) and c_gen == n_gen
-        if not drained:
-            ok_acct = ok_acct and c_done == s["evicted"] + s["shed"]
+        if router is not None:
+            # router-shed requests (drain cancels the global queue)
+            # never reached an engine, so they are absent from the
+            # per-replica completion counters by design
+            n_router_shed = len(results) - int(
+                sum(
+                    router.replica(i).stats()["evicted"]
+                    + router.replica(i).stats()["shed"]
+                    for i in range(router.num_replicas)
+                )
+            ) if drained else 0
+            ok_acct = (
+                c_done == len(results) - n_router_shed
+                and c_gen == n_gen
+                and s["completed"] == s["submitted"] == len(results)
+            )
+        else:
+            ok_acct = c_done == len(results) and c_gen == n_gen
+            if not drained:
+                ok_acct = ok_acct and c_done == s["evicted"] + s["shed"]
         print(f"telemetry: completions={c_done:.0f}/{len(results)} "
               f"generated_tokens={c_gen:.0f}/{n_gen} "
               f"({'consistent' if ok_acct else 'MISMATCH'})",
@@ -239,7 +311,15 @@ def main():
               f"{len(eng.completions)} request records -> {req_path}")
     if drained:
         return  # a drained run may stop before every program traced
-    if chunked:
+    if router is not None:
+        # host-only fabric: every replica still compiled ONE mixed
+        # program; the router never adds a trace
+        ok = all(
+            router.replica(i).mixed_trace_count == 1
+            and router.replica(i).decode_trace_count <= 1
+            for i in range(router.num_replicas)
+        )
+    elif chunked:
         # the fixed-shape contract: ONE mixed program for the whole
         # run regardless of the prompt mix (+ at most one decode-only
         # fast-path program)

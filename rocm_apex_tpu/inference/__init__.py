@@ -28,6 +28,12 @@ nothing is forked:
                chunk+decode step per tick (plus a decode-only fast
                path), donated cache buffers, no prompt-length ceiling;
                ``paged=True`` swaps in the block-table cache
+    router     multi-replica serving fabric: `ReplicaRouter` owns N
+               engines behind one surface — prefix-affinity +
+               least-loaded placement, replica failover with
+               token-identical in-flight recovery (prompt + emitted
+               tokens is the migration format), rolling drain/rejoin,
+               fleet chaos sites, merged fleet telemetry
 
 The model side lives in `models/gpt.py` (``cache=`` on `GPTModel`) and
 `ops/flash_attention.py` (`flash_attention_decode`); this package owns
@@ -54,6 +60,10 @@ from rocm_apex_tpu.inference.paging import (  # noqa: F401
     PagedKVCache,
     PrefixStore,
 )
+from rocm_apex_tpu.inference.router import (  # noqa: F401
+    REPLICA_STATES,
+    ReplicaRouter,
+)
 from rocm_apex_tpu.inference.sampling import (  # noqa: F401
     greedy,
     sample,
@@ -67,6 +77,8 @@ __all__ = [
     "PageAllocator",
     "PrefixStore",
     "InferenceEngine",
+    "ReplicaRouter",
+    "REPLICA_STATES",
     "NGramDrafter",
     "Fault",
     "FaultPlan",

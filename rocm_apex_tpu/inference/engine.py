@@ -48,7 +48,7 @@ order regardless of wall-clock timing.
 import collections
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional, Sequence, Set
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -258,6 +258,7 @@ class InferenceEngine:
         donate_buffers: Optional[bool] = None,
         registry=None,
         stats_retention: int = 4096,
+        step_source: Optional["InferenceEngine"] = None,
     ):
         cfg = model.cfg
         if (cfg.tensor_parallel_size or 1) > 1:
@@ -385,10 +386,13 @@ class InferenceEngine:
         self._queue: collections.deque = collections.deque()
         self._slots: List[Optional[_Slot]] = [None] * num_slots
         self._next_id = 0
-        self._prefill_traces = 0
-        self._decode_traces = 0
-        self._mixed_traces = 0
-        self._commit_traces = 0
+        # Trace counters live in ONE mutable cell so replicas built
+        # with `step_source=` (see below) share it: the fleet traced
+        # each program once, and every replica's `*_trace_count`
+        # reports that shared truth — a retrace anywhere still trips
+        # the `== 1` invariant the tests pin.
+        self._traces = {"prefill": 0, "decode": 0, "mixed": 0,
+                        "commit": 0}
         # serving telemetry (read via `stats()`, fed to a
         # monitor.MetricsLogger): monotonic counters + wall-time sums.
         # Latencies include the result fetch — on the tunnel platform
@@ -500,6 +504,7 @@ class InferenceEngine:
         self._step_retries = 0
         self._shed = 0
         self._watchdog_fires = 0
+        self._evacuated = 0
         self._draining = False
         self._tick = 0  # step() count — the fault plans' tick domain
         # queue_full results awaiting delivery through the next step()
@@ -508,6 +513,21 @@ class InferenceEngine:
         # observed, and the counter snapshot that defines "progress"
         self._last_progress = time.perf_counter()
         self._progress_mark = (0, 0, 0)
+
+        if step_source is not None:
+            # Replica fast-path: adopt an existing engine's compiled
+            # step programs instead of re-tracing identical ones. The
+            # traced graphs close over the model object, the sampling
+            # config, the cache geometry, and the donation flag — so
+            # adoption is refused unless all of them match. Used by
+            # ReplicaRouter: an N-replica fleet warms up once, not N
+            # times, and the shared trace-counter cell keeps every
+            # replica's `mixed_trace_count == 1` invariant honest.
+            if donate_buffers is None:
+                donate_buffers = on_tpu()
+            self.donate_buffers = bool(donate_buffers)
+            self._adopt_steps(step_source)
+            return
 
         sp = self.sampling
 
@@ -522,7 +542,7 @@ class InferenceEngine:
 
         def _prefill(params, cache, tokens, slot, length, rng):
             # trace-time side effect: counts COMPILES, not calls
-            self._prefill_traces += 1
+            self._traces["prefill"] += 1
             sub = cache.slot_view(slot)
             sub = sub.replace(lengths=jnp.zeros((1,), jnp.int32))
             logits, sub = model.apply(params, tokens, cache=sub)
@@ -583,7 +603,7 @@ class InferenceEngine:
             return jnp.where(active, tok, 0), bad, new_cache
 
         def _decode(params, cache, tokens, active, poison, rng):
-            self._decode_traces += 1
+            self._traces["decode"] += 1
             return _decode_body(params, cache, tokens, active, poison, rng)
 
         def _mixed(
@@ -602,7 +622,7 @@ class InferenceEngine:
             gets its second token in the same tick — exactly the
             whole-prompt path's admit-tick cadence, with no padded
             prefill."""
-            self._mixed_traces += 1
+            self._traces["mixed"] += 1
             rng_c, rng_d = jax.random.split(rng)
             cache = cache.replace(lengths=lengths_before)
             logits_c, cache = model.apply(
@@ -652,7 +672,7 @@ class InferenceEngine:
             and the host commits exactly the accepted prefix afterwards
             (`_commit`). One compiled program per engine run:
             ``mixed_trace_count`` stays 1 at any k."""
-            self._mixed_traces += 1
+            self._traces["mixed"] += 1
             rng_c, rng_d = jax.random.split(rng)
             cache = cache.replace(lengths=lengths_before)
             logits_c, cache, chunk_kv = model.apply(
@@ -688,7 +708,7 @@ class InferenceEngine:
             packed chunk K/V into the cache (`write_at` drops the pad
             sentinel rows). Fixed (budget,) shapes — ONE compiled
             commit program per engine run."""
-            self._commit_traces += 1
+            self._traces["commit"] += 1
             ck, cv = chunk_kv
             for i in range(n_layers):
                 cache = cache.write_at(i, slots, positions, ck[i], cv[i])
@@ -718,6 +738,59 @@ class InferenceEngine:
             _commit, donate_argnums=(0,) if self.donate_buffers else ()
         )
 
+    def _adopt_steps(self, src: "InferenceEngine") -> None:
+        """Alias `src`'s compiled step programs (and the trace-counter
+        cell they increment) into this engine. The traced graphs bake
+        in everything checked here; a mismatch would silently retrace
+        per call or, worse, run the wrong geometry — so refuse loudly.
+        """
+        def _shapes(tree):
+            return jax.tree_util.tree_map(
+                lambda a: (
+                    tuple(getattr(a, "shape", ())),
+                    str(getattr(a, "dtype", type(a).__name__)),
+                ),
+                tree,
+            )
+
+        mismatches = []
+        if src.model is not self.model:
+            mismatches.append("model (must be the SAME object)")
+        if src.sampling != self.sampling:
+            mismatches.append("sampling")
+        if src.prefill_token_budget != self.prefill_token_budget:
+            mismatches.append("prefill_token_budget")
+        if src.spec_k != self.spec_k:
+            mismatches.append("spec_k")
+        if src.paged != self.paged:
+            mismatches.append("paged")
+        if src.donate_buffers != self.donate_buffers:
+            mismatches.append("donate_buffers")
+        if type(src.cache) is not type(self.cache):
+            mismatches.append("cache layout")
+        elif _shapes(src.cache) != _shapes(self.cache):
+            mismatches.append(
+                "cache geometry (num_slots/capacity/page_size/dtype)"
+            )
+        if mismatches:
+            raise ValueError(
+                "step_source engine is incompatible; differs in: "
+                + ", ".join(mismatches)
+            )
+        self._traces = src._traces
+        self._prefill_fn = src._prefill_fn
+        self._decode_fn = src._decode_fn
+        self._mixed_fn = src._mixed_fn
+        self._mixed_spec_fn = src._mixed_spec_fn
+        self._commit_fn = src._commit_fn
+        self._prefill_jit = src._prefill_jit
+        self._decode_jit = src._decode_jit
+        self._mixed_jit = src._mixed_jit
+        self._mixed_spec_jit = src._mixed_spec_jit
+        self._commit_jit = src._commit_jit
+        if self.paged:
+            self._fork_jit = src._fork_jit
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -740,15 +813,15 @@ class InferenceEngine:
 
     @property
     def prefill_trace_count(self) -> int:
-        return self._prefill_traces
+        return self._traces["prefill"]
 
     @property
     def decode_trace_count(self) -> int:
-        return self._decode_traces
+        return self._traces["decode"]
 
     @property
     def mixed_trace_count(self) -> int:
-        return self._mixed_traces
+        return self._traces["mixed"]
 
     def has_work(self) -> bool:
         return (
@@ -921,6 +994,7 @@ class InferenceEngine:
             "step_retries": float(self._step_retries),
             "shed": float(self._shed),
             "watchdog_fires": float(self._watchdog_fires),
+            "evacuated": float(self._evacuated),
             "tokens_drafted": float(self._tokens_drafted),
             "tokens_accepted": float(self._tokens_accepted),
             "acceptance_rate": (
@@ -996,6 +1070,7 @@ class InferenceEngine:
         self._step_retries = 0
         self._shed = 0
         self._watchdog_fires = 0
+        self._evacuated = 0
         # the watchdog's progress snapshot tracks counters just zeroed
         self._progress_mark = (0, 0, 0)
         self._last_progress = time.perf_counter()
@@ -1183,10 +1258,17 @@ class InferenceEngine:
         to completion — the SIGTERM fast path. Stats counters and
         tracer events are all emitted by the time this returns; the
         caller flushes them (``stats()`` / ``export_chrome_trace``).
-        Bounded by the stall watchdog like any other stepping."""
+        Bounded by the stall watchdog like any other stepping.
+
+        Idempotent: a second drain on an already-draining (or already
+        drained) engine just runs any remaining work dry and returns
+        those results — no error, no duplicate drain markers — so a
+        supervisor and a signal handler can both call it. The return
+        path is `reopen()`."""
+        already = self._draining
         self._draining = True
         now = time.perf_counter()
-        if self.tracer.enabled:
+        if self.tracer.enabled and not already:
             self.tracer.instant(
                 "drain_begin", ts=now, track="engine",
                 queued=self.num_queued, active=self.num_active,
@@ -1199,11 +1281,218 @@ class InferenceEngine:
                 out.append(self._finalize_queued(req, "cancelled", now))
         while self.has_work():
             out.extend(self.step())
-        if self.tracer.enabled:
+        if self.tracer.enabled and not already:
             self.tracer.instant(
                 "drain_end", track="engine", finished=len(out),
             )
         return out
+
+    def reopen(self) -> None:
+        """Rejoin after `drain()` or a quarantine: reset the lifecycle
+        latches (drain flag, watchdog-fire count, progress anchors) so
+        admission reopens on the SAME engine — compiled programs,
+        cache, and prefix store survive, nothing retraces. The state
+        must be provably clean or this raises `RuntimeError`: no
+        leased slot, empty queue, no preempted carryover, no
+        undelivered shed results, and (paged) an all-sentinel block
+        table with the allocator's free-list/refcount invariants
+        intact. Callers that want the clean state first use
+        `evacuate()` / `drain()`; parked prefix pages are FINE — they
+        are the reusable prefix cache, not a leak."""
+        dirty = []
+        if any(st is not None for st in self._slots):
+            dirty.append(f"{self.num_active} leased slot(s)")
+        if self._queue:
+            dirty.append(f"{len(self._queue)} queued request(s)")
+        if self._preempted:
+            dirty.append(
+                f"{len(self._preempted)} preempted carryover(s)"
+            )
+        if self._shed_results:
+            dirty.append(
+                f"{len(self._shed_results)} undelivered shed result(s)"
+            )
+        if self.paged:
+            sentinel = self.cache.num_pages
+            mapped = int((self._table != sentinel).sum())
+            if mapped:
+                dirty.append(f"{mapped} mapped page-table entries")
+        if dirty:
+            raise RuntimeError(
+                "reopen() on a dirty engine: " + ", ".join(dirty)
+                + " — drain() or evacuate() first"
+            )
+        if self.paged:
+            # the allocator's own invariants (free-list / refcounts /
+            # parked set) must hold before we accept traffic again
+            self._allocator.assert_consistent()
+        self._draining = False
+        self._watchdog_fires = 0
+        self._progress_mark = (
+            self._prompt_tokens, self._generated_tokens, self._evicted,
+        )
+        self._last_progress = time.perf_counter()
+        if self.tracer.enabled:
+            self.tracer.instant("reopen", track="engine")
+
+    def outstanding(self) -> List[Dict[str, Any]]:
+        """Snapshot of every request this engine currently OWNS —
+        in-flight slots (slot order), then the queue (queue order) —
+        as migration records: ``request_id``, ``prompt``,
+        ``max_new_tokens``, ``generated`` (tokens emitted so far),
+        ``enqueued_at``/``deadline``/``queue_deadline`` (absolute
+        perf_counter times), ``first_token_at``, ``chunks``. A
+        prompt + its ``generated`` tokens IS the request's migration
+        format (the vLLM recompute transition): feed a record to
+        another engine's `resume_request` and greedy decode continues
+        token-identically. Pure read — engine state is untouched."""
+        recs: List[Dict[str, Any]] = []
+
+        def _rec(req: Request, generated, first_at, chunks):
+            recs.append({
+                "request_id": req.request_id,
+                "prompt": list(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "generated": list(generated),
+                "enqueued_at": req.enqueued_at,
+                "deadline": req.deadline,
+                "queue_deadline": req.queue_deadline,
+                "first_token_at": first_at,
+                "chunks": chunks,
+            })
+
+        for st in self._slots:
+            if st is not None:
+                _rec(st.req, st.generated, st.first_token_at, st.chunks)
+        for req in self._queue:
+            carried = self._preempted.get(req.request_id)
+            if carried is not None:
+                _rec(req, carried[0], carried[1], carried[2])
+            else:
+                _rec(req, [], 0.0, 0)
+        return recs
+
+    def evacuate(self) -> List[Dict[str, Any]]:
+        """Hand EVERY owned request off for migration: snapshot
+        `outstanding()`, then release all slots and pages and empty
+        the queue, leaving the engine provably clean for `reopen()`.
+        The records are returned to the caller (the router), which
+        re-owns their delivery — no completion is recorded here, so a
+        migrated request still finishes exactly once, on whichever
+        engine ultimately runs it. Store-registered prefix pages park
+        (they remain a valid cross-request cache); private pages
+        free. Host bookkeeping only."""
+        recs = self.outstanding()
+        for slot in range(self.num_slots - 1, -1, -1):
+            st = self._slots[slot]
+            if st is None:
+                continue
+            if self.paged:
+                self._release_slot_pages(st, slot)
+            self._slots[slot] = None
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "evacuate", track=f"req{st.req.request_id}",
+                    slot=slot, generated=len(st.generated),
+                )
+        if self.paged:
+            self._push_table()
+        self._queue.clear()
+        self._preempted.clear()
+        self._evacuated += len(recs)
+        return recs
+
+    def resume_request(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        request_id: int,
+        *,
+        generated: Sequence[int] = (),
+        enqueued_at: Optional[float] = None,
+        deadline: Optional[float] = None,
+        queue_deadline: Optional[float] = None,
+        first_token_at: float = 0.0,
+        chunks: int = 0,
+    ) -> int:
+        """Admit a request MIGRATED from another engine, carrying the
+        tokens it already emitted (an `outstanding()`/`evacuate()`
+        record). Re-admission recomputes prompt + generated[:-1]
+        through the ordinary chunked prefill — the PR-8 preemption
+        carryover — so greedy decode continues bitwise-identically
+        and no carried token is ever re-emitted. Deadlines are
+        ABSOLUTE (same perf_counter domain): a migrated request keeps
+        its original SLA clock. Unlike `add_request`, a full queue
+        never sheds a resumed request — it was already admitted once;
+        shedding it here would double-account it."""
+        if self._draining:
+            raise RuntimeError(
+                "engine is draining: admission is closed "
+                "(drain() was called)"
+            )
+        prompt = [int(t) for t in prompt]
+        generated = [int(t) for t in generated]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if len(prompt) > self.capacity:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the cache "
+                f"capacity {self.capacity} (rows per slot)"
+            )
+        if generated and not self.chunked:
+            raise ValueError(
+                "resume with carried tokens needs the chunked engine "
+                "(prefill_token_budget): the recompute prefix "
+                "prompt + generated[:-1] streams through the budget"
+            )
+        if len(generated) >= max_new_tokens:
+            raise ValueError(
+                f"carried {len(generated)} tokens >= max_new_tokens="
+                f"{max_new_tokens}: the request already finished"
+            )
+        now = time.perf_counter()
+        self._next_id = max(self._next_id, request_id) + 1
+        req = Request(
+            request_id, prompt, max_new_tokens,
+            enqueued_at=enqueued_at if enqueued_at is not None else now,
+            deadline=deadline,
+            queue_deadline=queue_deadline,
+        )
+        if generated:
+            self._preempted[request_id] = (
+                list(generated), first_token_at or now, int(chunks),
+            )
+        self._queue.append(req)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "resume", ts=now, track=f"req{request_id}",
+                carried=len(generated),
+            )
+        return request_id
+
+    def prefix_match_tokens(self, prompt: Sequence[int]) -> int:
+        """How many of ``prompt``'s tokens this engine's `PrefixStore`
+        already holds materialized (0 without prefix sharing). Pure
+        read — the router's prefix-affinity signal: route a prompt to
+        the replica that can skip the most prefill."""
+        if self._store is None:
+            return 0
+        return self._store.match([int(t) for t in prompt])[1]
+
+    @property
+    def pages_used(self) -> int:
+        """Pages holding a live mapping (0 on the contiguous cache) —
+        the memory-pressure term of least-loaded placement."""
+        return int(self._allocator.pages_used) if self.paged else 0
+
+    @property
+    def progress_marker(self) -> Tuple[int, int, int]:
+        """(prompt_tokens, generated_tokens, evicted) — the same
+        signals the stall watchdog watches, for an EXTERNAL
+        zero-progress detector (the router's stall probe)."""
+        return (
+            self._prompt_tokens, self._generated_tokens, self._evicted,
+        )
 
     #: consecutive zero-progress ticks `generate()` tolerates before
     #: diagnosing a stall (a backstop when no wall-clock watchdog is

@@ -24,6 +24,25 @@ Sites the engine threads through (see `InferenceEngine`):
     Raises `FaultInjected` between the device call and the value
     fetch — same retry path, different failure point.
 
+Replica-scoped sites the `ReplicaRouter` consults (the ``payload``
+names the target: ``{"replica": i}``; the router consults each site
+once per ROUTER tick, so ``tick`` schedules are in the router's tick
+domain, not any engine's):
+
+``replica_kill``
+    The replica is treated as crashed: the router quarantines it and
+    resubmits every request it held — from the router's own token
+    mirror, never the dead engine's state — to the healthy fleet.
+``replica_stall``
+    The replica stops being stepped for ``payload["ticks"]`` router
+    ticks (default 3): its requests make no progress, so the router's
+    zero-progress detector must notice and migrate them.
+``replica_slow``
+    Injected latency: ``payload["seconds"]`` of host sleep before
+    each of the replica's next ``payload["ticks"]`` steps (defaults
+    0.01 s × 1 tick) — skews that replica's TTFT/TPOT streams so the
+    merged-registry percentiles have something to reproduce.
+
 Hot-path contract: ``NO_FAULTS`` is the shared disabled plan (the
 `NULL_TRACER` idiom) — every call site gates on ``faults.enabled``
 first, so a fault-free engine pays one attribute check per site and
@@ -44,9 +63,14 @@ import numpy as np
 
 __all__ = ["Fault", "FaultPlan", "FaultInjected", "NO_FAULTS", "SITES"]
 
-#: The injection sites the engine threads (a plan may only name these —
-#: a typoed site must not silently never fire).
-SITES = ("page_alloc", "device_step", "logits", "host_fetch")
+#: The injection sites a plan may name — a typoed site must not
+#: silently never fire. The first four are consulted by the engine
+#: (per engine tick); the ``replica_*`` sites by the `ReplicaRouter`
+#: (per router tick, payload ``{"replica": i}``).
+SITES = (
+    "page_alloc", "device_step", "logits", "host_fetch",
+    "replica_kill", "replica_stall", "replica_slow",
+)
 
 
 class FaultInjected(RuntimeError):

@@ -1,0 +1,891 @@
+"""Multi-replica serving fabric: the host-level `ReplicaRouter`.
+
+One stalled engine must never be a total outage. The router owns N
+independent `InferenceEngine` replicas behind the engine's own surface
+(`add_request` / `step` / `generate` / `stats` / `drain`) and adds the
+fleet behaviours the single engine cannot express:
+
+**Routing & admission.** A bounded global queue feeds per-replica
+admission: each router tick dispatches pending requests to in-rotation
+replicas, prefix-affinity first — the `PrefixStore` chain hash
+(`InferenceEngine.prefix_match_tokens`) routes a prompt to the replica
+already holding its prefix pages, so CoW sharing keeps working across
+the fleet — then least-loaded by the replica's live signals (queue
+depth, slot occupancy, ``pages_used``). Per-replica backlogs stay
+shallow (``replica_queue_depth``) so work left in the GLOBAL queue can
+still be placed anywhere when a replica dies.
+
+**Failure detection & recovery.** Three detectors run every tick:
+consecutive `step()` failures (device faults, watchdog raises),
+`engine_health`-style probes (watchdog-fire count), and a
+zero-progress probe over `progress_marker` for replicas that have work
+but move no tokens. A replica crossing its threshold is QUARANTINED
+and every request it held is resubmitted to the rest of the fleet as
+prompt + tokens emitted so far — the vLLM recompute transition (arXiv
+2309.06180) generalized to replica death. Continuation is pure greedy
+decode through the destination's chunked prefill (arXiv 2403.02310),
+so recovered outputs are token-identical to an undisturbed run and no
+token is ever emitted twice: the router delivers each request's result
+exactly once (`_deliver` enforces it). For `replica_kill` the engine's
+state is presumed LOST — recovery reads the router's own per-request
+token mirror (refreshed from `outstanding()` after every successful
+replica tick), never the dead engine; the carcass is then evacuated so
+its pages and slots provably free. A quarantined replica is re-probed
+after ``rejoin_after`` ticks: `InferenceEngine.reopen()` verifies the
+clean state and the replica rejoins rotation.
+
+**Rolling drain.** `drain_replica(i)` migrates the replica's queue and
+in-flight work to the fleet and takes it out of rotation —
+restart-without-downtime; `rejoin_replica(i)` is the return path.
+`drain()` drains the whole fleet.
+
+**Fleet chaos & telemetry.** The same seeded `FaultPlan` that drives
+engine-level chaos gains replica-scoped sites (``replica_kill`` /
+``replica_stall`` / ``replica_slow``, consulted once per router tick;
+``fault_log`` records the (site, tick, replica) sequence so `reset()`
+replays bit-identically). Router events land in a router-local
+`MetricRegistry` and `merged_registry()` folds it with every replica's
+registry via ``merge_from`` — bucket-wise histogram merge is exact, so
+fleet `/metrics` percentiles reproduce the combined per-replica
+completion streams (serve it per-scrape through the exporter's
+zero-arg registry provider).
+
+Everything here is host bookkeeping: the compiled programs never see
+the router, each replica's ``mixed_trace_count`` stays 1, and the
+graphlint fingerprints are unchanged.
+"""
+
+import collections
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from rocm_apex_tpu.inference.engine import (
+    GenerationResult,
+    InferenceEngine,
+)
+from rocm_apex_tpu.inference.faults import NO_FAULTS, FaultPlan
+from rocm_apex_tpu.monitor.trace import NULL_TRACER
+
+__all__ = ["ReplicaRouter", "REPLICA_STATES"]
+
+#: Replica rotation states: ``up`` serves traffic; ``quarantined`` was
+#: failed out and awaits a rejoin probe; ``drained`` was rolled out on
+#: purpose (`drain_replica`) and waits for `rejoin_replica`.
+REPLICA_STATES = ("up", "quarantined", "drained")
+
+
+class _Replica:
+    """Router-side bookkeeping for one engine."""
+
+    def __init__(self, index: int, engine: InferenceEngine):
+        self.index = index
+        self.engine = engine
+        self.state = "up"
+        self.consecutive_failures = 0
+        self.no_progress_ticks = 0
+        self.progress_mark = engine.progress_marker
+        self.quarantined_at = -1
+        self.last_error = ""
+        # injected-fault latches (replica_stall / replica_slow)
+        self.stall_ticks = 0
+        self.slow_ticks = 0
+        self.slow_seconds = 0.0
+
+    @property
+    def in_rotation(self) -> bool:
+        return self.state == "up"
+
+
+class ReplicaRouter:
+    """N `InferenceEngine` replicas behind one serving surface.
+
+    Build replicas from a model (each with a private registry, the
+    shared fault plan, and identical ``engine_kwargs`` — identical
+    configs keep greedy outputs replica-independent)::
+
+        router = ReplicaRouter(model, params, replicas=2,
+                               engine_kwargs=dict(num_slots=2, ...))
+
+    or wrap engines you built yourself (``engines=[...]``; they must
+    be chunked — migration recomputes through the prefill budget).
+
+    ``max_queue`` bounds the GLOBAL queue (shed-newest, ``queue_full``
+    results delivered through `step()`, exactly like the engine's
+    bounded admission). ``failure_threshold`` consecutive step
+    failures, any watchdog fire, or ``stall_grace`` zero-progress
+    ticks quarantine a replica; after ``rejoin_after`` router ticks a
+    quarantine is probed for rejoin (`reopen()` + health). Pass
+    ``faults`` to drive fleet chaos (see module docstring).
+    """
+
+    def __init__(
+        self,
+        model=None,
+        params=None,
+        *,
+        replicas: int = 2,
+        engines: Optional[Sequence[InferenceEngine]] = None,
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        max_queue: Optional[int] = None,
+        replica_queue_depth: int = 2,
+        faults: Optional[FaultPlan] = None,
+        failure_threshold: int = 2,
+        stall_grace: int = 3,
+        rejoin_after: int = 8,
+        registry=None,
+        tracer=None,
+    ):
+        self.faults = faults if faults is not None else NO_FAULTS
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        if engines is not None:
+            engines = list(engines)
+        else:
+            if model is None or params is None:
+                raise ValueError(
+                    "pass model+params (the router builds the "
+                    "replicas) or engines=[...]"
+                )
+            kw = dict(engine_kwargs or {})
+            if "prefill_token_budget" not in kw:
+                raise ValueError(
+                    "engine_kwargs must set prefill_token_budget: "
+                    "migration recomputes prompt + emitted tokens "
+                    "through the chunked prefill"
+                )
+            kw.pop("registry", None)  # each replica scrapes privately
+            kw.setdefault("faults", self.faults)
+            kw.pop("step_source", None)
+            # identical configs -> replicas 1..N adopt replica 0's
+            # compiled step programs: the fleet traces (and warms up)
+            # once, not N times
+            engines = [InferenceEngine(model, params, **kw)]
+            for _ in range(1, int(replicas)):
+                engines.append(
+                    InferenceEngine(
+                        model, params, step_source=engines[0], **kw
+                    )
+                )
+        if not engines:
+            raise ValueError("need at least one replica")
+        for i, eng in enumerate(engines):
+            if not eng.chunked:
+                raise ValueError(
+                    f"replica {i} is a whole-prompt engine; the "
+                    f"router needs chunked engines "
+                    f"(prefill_token_budget) so migrated requests can "
+                    f"recompute their carried tokens"
+                )
+        self._replicas = [
+            _Replica(i, eng) for i, eng in enumerate(engines)
+        ]
+        self.capacity = min(eng.capacity for eng in engines)
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        if replica_queue_depth < 0:
+            raise ValueError(
+                f"replica_queue_depth must be >= 0, got "
+                f"{replica_queue_depth}"
+            )
+        self.replica_queue_depth = int(replica_queue_depth)
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got "
+                f"{failure_threshold}"
+            )
+        self.failure_threshold = int(failure_threshold)
+        if stall_grace < 1:
+            raise ValueError(
+                f"stall_grace must be >= 1, got {stall_grace}"
+            )
+        self.stall_grace = int(stall_grace)
+        if rejoin_after < 1:
+            raise ValueError(
+                f"rejoin_after must be >= 1, got {rejoin_after}"
+            )
+        self.rejoin_after = int(rejoin_after)
+        # the global queue: migration records (prompt + carried
+        # tokens), dispatched to replicas via resume_request — one
+        # admission path for fresh AND recovered requests
+        self._pending: collections.deque = collections.deque()
+        self._assigned: Dict[int, int] = {}  # rid -> replica index
+        # the router's OWN copy of every live request's emitted
+        # tokens, refreshed after each successful replica tick — the
+        # recovery source when an engine dies without warning
+        self._mirror: Dict[int, Dict[str, Any]] = {}
+        self._shed_results: List[GenerationResult] = []
+        self._done: set = set()
+        self._next_id = 0
+        self._tick = 0
+        self._draining = False
+        self._submitted = 0
+        self._shed = 0
+        self._migrations = 0
+        self._quarantines = 0
+        self._rejoins = 0
+        self._affinity_hits = 0
+        self._kills = 0
+        self._finished: Dict[str, int] = {}
+        #: every replica-scoped fault that fired, as (site, tick,
+        #: replica) — the `FaultPlan.reset()` replay witness
+        self.fault_log: List[tuple] = []
+        if registry is None:
+            from rocm_apex_tpu.monitor.telemetry import MetricRegistry
+
+            registry = MetricRegistry()
+        self.registry = registry
+        self._c_events = registry.counter(
+            "router_events_total",
+            "Fleet lifecycle events (migration, quarantine, rejoin, "
+            "affinity_hit, kill, shed, drain_replica).",
+            labelnames=("event",),
+        )
+        self._g_healthy = registry.gauge(
+            "router_healthy_replicas", "Replicas in rotation."
+        )
+        self._g_pending = registry.gauge(
+            "router_queue_depth", "Requests in the global queue."
+        )
+        self._g_healthy.set(len(self._replicas))
+
+    # ------------------------------------------------------------------
+    # public surface (mirrors InferenceEngine)
+    # ------------------------------------------------------------------
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def tick_count(self) -> int:
+        return self._tick
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def replica(self, i: int) -> InferenceEngine:
+        return self._replicas[i].engine
+
+    def replica_state(self, i: int) -> str:
+        return self._replicas[i].state
+
+    @property
+    def healthy_replicas(self) -> int:
+        return sum(1 for rep in self._replicas if rep.in_rotation)
+
+    def has_work(self) -> bool:
+        return bool(
+            self._pending or self._shed_results or self._assigned
+            or any(
+                rep.engine.has_work() for rep in self._replicas
+            )
+        )
+
+    def add_request(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        request_id: Optional[int] = None,
+        *,
+        timeout: Optional[float] = None,
+        queue_ttl: Optional[float] = None,
+    ) -> int:
+        """Queue a prompt with the fleet; same contract as
+        `InferenceEngine.add_request` (ids, deadlines, bounded
+        admission with shed-newest ``queue_full`` results delivered by
+        the next `step()`, raises once draining). Placement happens at
+        the next tick's dispatch."""
+        if self._draining:
+            raise RuntimeError(
+                "router is draining: admission is closed "
+                "(drain() was called)"
+            )
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if len(prompt) > self.capacity:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the fleet cache "
+                f"capacity {self.capacity} (rows per slot)"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if timeout is not None and timeout <= 0:
+            raise ValueError(f"timeout must be > 0 s, got {timeout}")
+        if queue_ttl is not None and queue_ttl <= 0:
+            raise ValueError(
+                f"queue_ttl must be > 0 s, got {queue_ttl}"
+            )
+        if request_id is None:
+            request_id = self._next_id
+        self._next_id = max(self._next_id, request_id) + 1
+        now = time.perf_counter()
+        self._submitted += 1
+        if (
+            self.max_queue is not None
+            and len(self._pending) >= self.max_queue
+        ):
+            self._shed += 1
+            self._count_event("shed")
+            self._shed_results.append(GenerationResult(
+                request_id=request_id, prompt=prompt, tokens=[],
+                finish_reason="queue_full",
+            ))
+            return request_id
+        self._pending.append({
+            "request_id": request_id,
+            "prompt": prompt,
+            "max_new_tokens": int(max_new_tokens),
+            "generated": [],
+            "enqueued_at": now,
+            "deadline": (now + timeout) if timeout is not None else None,
+            "queue_deadline": (
+                (now + queue_ttl) if queue_ttl is not None else None
+            ),
+            "first_token_at": 0.0,
+            "chunks": 0,
+        })
+        return request_id
+
+    def step(self) -> List[GenerationResult]:
+        """One fleet tick: consult the replica fault sites, expire
+        global-queue deadlines, dispatch pending work, step every
+        in-rotation replica (collecting finishes and refreshing the
+        token mirror), then run the failure detectors and rejoin
+        probes. Returns every request that finished this tick —
+        exactly once each, whichever replica(s) it lived on."""
+        now = time.perf_counter()
+        out: List[GenerationResult] = []
+        if self._shed_results:
+            out.extend(self._shed_results)
+            for r in self._shed_results:
+                self._mark_done(r)
+            self._shed_results = []
+        self._consult_faults()
+        self._expire_pending(now, out)
+        self._dispatch(now)
+        for rep in self._replicas:
+            if not rep.in_rotation:
+                continue
+            if rep.stall_ticks > 0:
+                # injected stall: the replica is not stepped — its
+                # requests sit, and the zero-progress probe below is
+                # what must notice
+                rep.stall_ticks -= 1
+                continue
+            if rep.slow_ticks > 0 and rep.engine.has_work():
+                rep.slow_ticks -= 1
+                time.sleep(rep.slow_seconds)
+            if not rep.engine.has_work():
+                rep.consecutive_failures = 0
+                rep.no_progress_ticks = 0
+                rep.progress_mark = rep.engine.progress_marker
+                continue
+            try:
+                results = rep.engine.step()
+            except Exception as exc:  # noqa: BLE001 - fault isolation
+                rep.consecutive_failures += 1
+                rep.last_error = f"{type(exc).__name__}: {exc}"
+                if (
+                    rep.consecutive_failures >= self.failure_threshold
+                ):
+                    self._quarantine_replica(
+                        rep, why=f"step failures: {rep.last_error}"
+                    )
+                continue
+            rep.consecutive_failures = 0
+            for r in results:
+                self._deliver(r, out)
+            self._refresh_mirror(rep)
+        self._probe_health()
+        self._probe_progress()
+        self._probe_rejoin()
+        self._tick += 1
+        if self.registry.enabled:
+            self._g_healthy.set(self.healthy_replicas)
+            self._g_pending.set(len(self._pending))
+        return out
+
+    def cancel(self, request_id: int) -> Optional[GenerationResult]:
+        """Cancel one request wherever it lives — global queue or any
+        replica — returning the partial result, or None if unknown or
+        already finished."""
+        for rec in self._pending:
+            if rec["request_id"] == request_id:
+                self._pending.remove(rec)
+                r = self._pending_result(rec, "cancelled")
+                self._mark_done(r)
+                return r
+        idx = self._assigned.get(request_id)
+        if idx is None:
+            return None
+        r = self._replicas[idx].engine.cancel(request_id)
+        if r is not None:
+            self._mark_done(r)
+        return r
+
+    #: consecutive zero-finish/zero-progress fleet ticks tolerated by
+    #: the bounded loops (`generate`/`drain`) before diagnosing a
+    #: wedged fleet — mirrors InferenceEngine._GENERATE_STALL_TICKS
+    _STALL_TICKS = 1000
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+    ) -> List[GenerationResult]:
+        """Batch convenience: queue every prompt, run the fleet dry,
+        return results in prompt order (same contract as the
+        engine's `generate`). Bounded: a long run of ticks with no
+        progress raises a diagnostic instead of spinning."""
+        ids = [self.add_request(p, max_new_tokens) for p in prompts]
+        done: Dict[int, GenerationResult] = {}
+        self._run_dry(done)
+        return [done[i] for i in ids]
+
+    def drain(self, shed_queue: bool = False) -> List[GenerationResult]:
+        """Fleet shutdown: close admission, run every replica dry
+        (migrating off any that fail on the way down), close each
+        engine's own admission, and return the remaining results.
+        ``shed_queue=True`` cancels the still-pending global queue up
+        front. Idempotent."""
+        already, self._draining = self._draining, True
+        out: List[GenerationResult] = []
+        if shed_queue:
+            while self._pending:
+                rec = self._pending.popleft()
+                r = self._pending_result(rec, "cancelled")
+                self._mark_done(r)
+                out.append(r)
+        done: Dict[int, GenerationResult] = {}
+        self._run_dry(done)
+        out.extend(done.values())
+        if not already:
+            for rep in self._replicas:
+                if rep.in_rotation:
+                    rep.engine.drain()
+        return out
+
+    def drain_replica(self, i: int) -> None:
+        """Rolling restart, step 1: migrate replica ``i``'s queue and
+        in-flight work to the rest of the fleet and take it out of
+        rotation (state ``drained``, engine admission closed). The
+        fleet keeps serving throughout — survivors' decodes never
+        stall on this. `rejoin_replica(i)` is step 2."""
+        rep = self._replicas[i]
+        if rep.state == "drained":
+            return
+        recs = rep.engine.evacuate()
+        self._requeue(recs)
+        rep.engine.drain()  # idempotent; closes the engine's admission
+        rep.state = "drained"
+        self._count_event("drain_replica")
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "drain_replica", track="router", replica=i,
+                migrated=len(recs),
+            )
+
+    def rejoin_replica(self, i: int) -> None:
+        """Rolling restart, step 2: `reopen()` the drained (or
+        quarantined) replica — the clean-state proof lives there —
+        and put it back in rotation."""
+        rep = self._replicas[i]
+        if rep.in_rotation:
+            return
+        rep.engine.reopen()
+        rep.state = "up"
+        rep.consecutive_failures = 0
+        rep.no_progress_ticks = 0
+        rep.progress_mark = rep.engine.progress_marker
+        self._rejoins += 1
+        self._count_event("rejoin")
+        if self.tracer.enabled:
+            self.tracer.instant("rejoin", track="router", replica=i)
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        """Fleet counters (one flat dict, the engine `stats()` shape):
+        router-level lifecycle events plus per-reason finish counts
+        (``finished_<reason>``; delivered shed requests count under
+        ``finished_queue_full``). The fleet accounting identity —
+        every submitted request is accounted exactly once:
+        ``completed + undelivered-shed + pending + in_flight ==
+        submitted`` at any tick boundary, and after `drain()` simply
+        ``completed == submitted``."""
+        out: Dict[str, float] = {
+            "replicas": float(self.num_replicas),
+            "healthy_replicas": float(self.healthy_replicas),
+            "pending_depth": float(len(self._pending)),
+            "in_flight": float(len(self._assigned)),
+            "submitted": float(self._submitted),
+            "completed": float(len(self._done)),
+            "shed": float(self._shed),
+            "migrations": float(self._migrations),
+            "replica_quarantines": float(self._quarantines),
+            "replica_rejoins": float(self._rejoins),
+            "affinity_hits": float(self._affinity_hits),
+            "replica_kills": float(self._kills),
+        }
+        for reason, n in sorted(self._finished.items()):
+            out[f"finished_{reason}"] = float(n)
+        return out
+
+    def merged_registry(self):
+        """One fresh `MetricRegistry` holding the router's own series
+        merged with EVERY replica's registry (``merge_from`` — counter
+        and histogram-bucket adds are exact and associative), so
+        fleet-level percentiles reproduce the combined per-replica
+        completion streams. Build per scrape: pass this METHOD (not
+        its result) to the exporter as the zero-arg registry
+        provider."""
+        from rocm_apex_tpu.monitor.telemetry import MetricRegistry
+
+        merged = MetricRegistry()
+        merged.merge_from(self.registry)
+        for rep in self._replicas:
+            if rep.engine.registry.enabled:
+                merged.merge_from(rep.engine.registry)
+        return merged
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet liveness for `/healthz`: healthy while ANY replica
+        remains in rotation — one dead replica is the fabric working,
+        zero is the outage a load balancer must see as 503.
+        Per-replica detail lives in `varz()`."""
+        return {
+            "healthy": self.healthy_replicas > 0,
+            "replicas": self.num_replicas,
+            "healthy_replicas": self.healthy_replicas,
+            "draining": self._draining,
+            "queue_depth": len(self._pending),
+            "ticks": self._tick,
+        }
+
+    def varz(self) -> Dict[str, Any]:
+        """Per-replica detail for `/varz`: rotation state, failure
+        latches, and each engine's own health signals."""
+        return {
+            "router": self.stats(),
+            "replica_detail": [
+                {
+                    "replica": rep.index,
+                    "state": rep.state,
+                    "consecutive_failures": rep.consecutive_failures,
+                    "no_progress_ticks": rep.no_progress_ticks,
+                    "last_error": rep.last_error,
+                    "watchdog_fires": int(
+                        getattr(rep.engine, "_watchdog_fires", 0)
+                    ),
+                    "draining": rep.engine.draining,
+                    "queue_depth": rep.engine.num_queued,
+                    "slots_active": rep.engine.num_active,
+                    "pages_used": rep.engine.pages_used,
+                }
+                for rep in self._replicas
+            ],
+        }
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _count_event(self, event: str) -> None:
+        if self.registry.enabled:
+            self._c_events.inc(event=event)
+
+    def _run_dry(self, done: Dict[int, GenerationResult]) -> None:
+        stale = 0
+        mark = (len(self._done), self._progress_signature())
+        while self.has_work():
+            results = self.step()
+            for r in results:
+                done[r.request_id] = r
+            work = (len(self._done), self._progress_signature())
+            if results or work != mark:
+                stale, mark = 0, work
+                continue
+            stale += 1
+            if stale >= self._STALL_TICKS:
+                states = {
+                    rep.index: rep.state for rep in self._replicas
+                }
+                raise RuntimeError(
+                    f"fleet stalled: {stale} consecutive ticks with "
+                    f"no progress; pending={len(self._pending)} "
+                    f"in_flight={len(self._assigned)} "
+                    f"replicas={states}"
+                )
+
+    def _progress_signature(self):
+        return tuple(
+            rep.engine.progress_marker for rep in self._replicas
+        )
+
+    def _expire_pending(
+        self, now: float, out: List[GenerationResult]
+    ) -> None:
+        """Deadline sweep over the GLOBAL queue (requests a dead fleet
+        could not place still expire on time)."""
+        if not self._pending:
+            return
+        keep: collections.deque = collections.deque()
+        for rec in self._pending:
+            expired = (
+                (rec["queue_deadline"] is not None
+                 and now > rec["queue_deadline"])
+                or (rec["deadline"] is not None
+                    and now > rec["deadline"])
+            )
+            if expired:
+                r = self._pending_result(rec, "deadline")
+                self._mark_done(r)
+                out.append(r)
+            else:
+                keep.append(rec)
+        self._pending = keep
+
+    def _pending_result(
+        self, rec: Dict[str, Any], reason: str
+    ) -> GenerationResult:
+        # a recovered request waiting in the global queue keeps the
+        # tokens it already emitted — they were delivered work
+        return GenerationResult(
+            request_id=rec["request_id"], prompt=list(rec["prompt"]),
+            tokens=list(rec["generated"]), finish_reason=reason,
+        )
+
+    def _dispatch(self, now: float) -> None:
+        """Drain the global queue into the fleet: prefix-affinity
+        first, least-loaded otherwise, bounded per-replica backlog."""
+        while self._pending:
+            candidates = [
+                rep for rep in self._replicas
+                if rep.in_rotation and rep.stall_ticks == 0
+                and (
+                    rep.engine.num_active < rep.engine.num_slots
+                    or rep.engine.num_queued < self.replica_queue_depth
+                )
+            ]
+            if not candidates:
+                return
+            rec = self._pending.popleft()
+            rep = self._place(rec, candidates)
+            rid = rec["request_id"]
+            rep.engine.resume_request(
+                rec["prompt"], rec["max_new_tokens"], rid,
+                generated=rec["generated"],
+                enqueued_at=rec["enqueued_at"],
+                deadline=rec["deadline"],
+                queue_deadline=rec["queue_deadline"],
+                first_token_at=rec["first_token_at"],
+                chunks=rec["chunks"],
+            )
+            self._assigned[rid] = rep.index
+            self._mirror[rid] = rec
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "dispatch", ts=now, track=f"req{rid}",
+                    replica=rep.index, carried=len(rec["generated"]),
+                )
+
+    def _place(
+        self, rec: Dict[str, Any], candidates: List[_Replica]
+    ) -> _Replica:
+        # prefix affinity: the replica already holding the longest
+        # materialized prefix of this prompt skips that much prefill
+        # (recovered requests carry tokens and re-prefill anyway, so
+        # affinity only scores fresh prompts)
+        if not rec["generated"]:
+            best, best_tokens = None, 0
+            for rep in candidates:
+                n = rep.engine.prefix_match_tokens(rec["prompt"])
+                if n > best_tokens:
+                    best, best_tokens = rep, n
+            if best is not None:
+                self._affinity_hits += 1
+                self._count_event("affinity_hit")
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "affinity_hit",
+                        track=f"req{rec['request_id']}",
+                        replica=best.index, tokens=best_tokens,
+                    )
+                return best
+        # least-loaded: fewest owned requests, then fewest live pages,
+        # then lowest index (deterministic tie-break)
+        return min(
+            candidates,
+            key=lambda rep: (
+                rep.engine.num_active + rep.engine.num_queued,
+                rep.engine.pages_used,
+                rep.index,
+            ),
+        )
+
+    def _deliver(
+        self, r: GenerationResult, out: List[GenerationResult]
+    ) -> None:
+        self._mark_done(r)
+        out.append(r)
+
+    def _mark_done(self, r: GenerationResult) -> None:
+        rid = r.request_id
+        if rid in self._done:
+            # the no-duplicate guarantee is the recovery contract;
+            # a second result for one id means migration double-owned
+            # a request — refuse to deliver it silently
+            raise RuntimeError(
+                f"request {rid} finished twice "
+                f"(second finish_reason={r.finish_reason!r})"
+            )
+        self._done.add(rid)
+        self._finished[r.finish_reason] = (
+            self._finished.get(r.finish_reason, 0) + 1
+        )
+        self._assigned.pop(rid, None)
+        self._mirror.pop(rid, None)
+
+    def _refresh_mirror(self, rep: _Replica) -> None:
+        for rec in rep.engine.outstanding():
+            mine = self._mirror.get(rec["request_id"])
+            if mine is not None:
+                mine["generated"] = rec["generated"]
+                mine["first_token_at"] = rec["first_token_at"]
+                mine["chunks"] = rec["chunks"]
+
+    def _requeue(self, recs: List[Dict[str, Any]]) -> None:
+        """Resubmit migration records at the HEAD of the global queue
+        (preserving their order ahead of fresh arrivals)."""
+        for rec in reversed(recs):
+            rid = rec["request_id"]
+            self._assigned.pop(rid, None)
+            self._mirror.pop(rid, None)
+            self._pending.appendleft(rec)
+            self._migrations += 1
+            self._count_event("migration")
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "migrate", track=f"req{rid}",
+                    carried=len(rec["generated"]),
+                )
+
+    def _quarantine_replica(self, rep: _Replica, why: str) -> None:
+        """Failure path for a replica whose ENGINE is still intact
+        (step failures, watchdog, zero progress): evacuate its exact
+        request inventory and put it back on the global queue."""
+        recs = rep.engine.evacuate()
+        self._requeue(recs)
+        rep.state = "quarantined"
+        rep.quarantined_at = self._tick
+        rep.last_error = why
+        self._quarantines += 1
+        self._count_event("quarantine")
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "quarantine_replica", track="router",
+                replica=rep.index, why=why, migrated=len(recs),
+            )
+
+    def _kill_replica(self, rep: _Replica) -> None:
+        """`replica_kill`: the engine is presumed crashed — recover
+        every request it held from the ROUTER's token mirror (the
+        engine's own state is not trusted), then evacuate the carcass
+        so its pages and slots provably free before any rejoin."""
+        recs = [
+            dict(self._mirror[rid], generated=list(
+                self._mirror[rid]["generated"]
+            ))
+            for rid, idx in sorted(self._assigned.items())
+            if idx == rep.index and rid in self._mirror
+        ]
+        rep.engine.evacuate()  # discard — recovery used the mirror
+        self._requeue(recs)
+        rep.state = "quarantined"
+        rep.quarantined_at = self._tick
+        rep.last_error = "replica_kill (chaos)"
+        self._kills += 1
+        self._quarantines += 1
+        self._count_event("kill")
+        self._count_event("quarantine")
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "kill_replica", track="router", replica=rep.index,
+                recovered=len(recs),
+            )
+
+    def _consult_faults(self) -> None:
+        if not self.faults.enabled:
+            return
+        for site in ("replica_kill", "replica_stall", "replica_slow"):
+            f = self.faults.fire(site, tick=self._tick)
+            if f is None:
+                continue
+            payload = dict(f.payload or {})
+            idx = int(payload.get("replica", 0)) % self.num_replicas
+            self.fault_log.append((site, self._tick, idx))
+            rep = self._replicas[idx]
+            if site == "replica_kill":
+                if rep.in_rotation:
+                    self._kill_replica(rep)
+            elif site == "replica_stall":
+                rep.stall_ticks += int(payload.get("ticks", 3))
+                self._count_event("stall")
+            else:  # replica_slow
+                rep.slow_ticks += int(payload.get("ticks", 1))
+                rep.slow_seconds = float(
+                    payload.get("seconds", 0.01)
+                )
+                self._count_event("slow")
+
+    def _probe_health(self) -> None:
+        """The `engine_health` probe, inlined: any watchdog fire on an
+        in-rotation replica quarantines it this tick."""
+        for rep in self._replicas:
+            if not rep.in_rotation:
+                continue
+            if int(getattr(rep.engine, "_watchdog_fires", 0)) > 0:
+                self._quarantine_replica(rep, why="watchdog fired")
+
+    def _probe_progress(self) -> None:
+        """Zero-progress detector: a replica that OWNS work but moved
+        no tokens for `stall_grace` consecutive ticks is wedged
+        (injected stall, deadlocked pool, hung host thread) —
+        quarantine and migrate."""
+        for rep in self._replicas:
+            if not rep.in_rotation:
+                continue
+            if not rep.engine.has_work():
+                rep.no_progress_ticks = 0
+                rep.progress_mark = rep.engine.progress_marker
+                continue
+            mark = rep.engine.progress_marker
+            if mark != rep.progress_mark:
+                rep.no_progress_ticks = 0
+                rep.progress_mark = mark
+                continue
+            rep.no_progress_ticks += 1
+            if rep.no_progress_ticks >= self.stall_grace:
+                self._quarantine_replica(rep, why="zero progress")
+
+    def _probe_rejoin(self) -> None:
+        """Quarantined replicas are probed back: after `rejoin_after`
+        ticks (and any injected stall has lapsed), `reopen()` proves
+        the clean state and the replica rejoins rotation; a failed
+        probe leaves it quarantined for the next round."""
+        for rep in self._replicas:
+            if rep.state != "quarantined":
+                continue
+            if rep.stall_ticks > 0:
+                rep.stall_ticks -= 1
+                continue
+            if self._tick - rep.quarantined_at < self.rejoin_after:
+                continue
+            try:
+                self.rejoin_replica(rep.index)
+            except RuntimeError as exc:
+                rep.last_error = f"rejoin probe failed: {exc}"

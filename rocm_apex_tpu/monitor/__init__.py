@@ -67,6 +67,7 @@ from rocm_apex_tpu.monitor.flops import (
 from rocm_apex_tpu.monitor.exporter import (
     TelemetryServer,
     engine_health,
+    fleet_health,
     start_exporter,
 )
 from rocm_apex_tpu.monitor.logger import (
@@ -154,5 +155,6 @@ __all__ = [
     "DEFAULT_BURN_RULES",
     "TelemetryServer",
     "engine_health",
+    "fleet_health",
     "start_exporter",
 ]

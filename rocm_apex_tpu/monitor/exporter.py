@@ -31,13 +31,14 @@ the GIL-free engine hot path. `close()` is idempotent.
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Union
 
 from rocm_apex_tpu.monitor.telemetry import MetricRegistry
 
 __all__ = [
     "TelemetryServer",
     "engine_health",
+    "fleet_health",
     "start_exporter",
     "PROMETHEUS_CONTENT_TYPE",
 ]
@@ -64,6 +65,17 @@ def engine_health(engine) -> Callable[[], Dict[str, Any]]:
         }
 
     return _health
+
+
+def fleet_health(router) -> Callable[[], Dict[str, Any]]:
+    """Liveness report for an `inference.ReplicaRouter`: healthy —
+    and therefore 200 on `/healthz` — while ANY replica remains in
+    rotation. One quarantined replica is the fabric doing its job;
+    zero healthy replicas is the outage a load balancer must see as
+    503. Per-replica detail is deliberately kept OUT of the probe
+    body (probes should stay tiny and fast) — it lives in `/varz`
+    via ``router.varz``."""
+    return router.health
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -112,6 +124,13 @@ class _Handler(BaseHTTPRequestHandler):
 class TelemetryServer:
     """Background scrape endpoint over one registry.
 
+    ``registry`` is either a `MetricRegistry` or a ZERO-ARG PROVIDER
+    returning one, resolved fresh on every scrape — the multi-replica
+    hook: pass ``router.merged_registry`` (the method) and each
+    `/metrics` hit serves a registry merged from the live fleet at
+    that instant, so the scraped percentiles always reproduce the
+    combined per-replica streams.
+
     ``port=0`` (default) binds an ephemeral port — read ``.port``
     after `start`. ``host`` defaults to loopback (see the module
     security note before changing it). Use as a context manager or
@@ -119,7 +138,9 @@ class TelemetryServer:
 
     def __init__(
         self,
-        registry: MetricRegistry,
+        registry: Union[
+            MetricRegistry, Callable[[], MetricRegistry]
+        ],
         *,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -127,7 +148,7 @@ class TelemetryServer:
         varz_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         slo_monitor=None,
     ):
-        self.registry = registry
+        self._registry_source = registry
         self.health_fn = health_fn
         self.varz_fn = varz_fn
         self.slo_monitor = slo_monitor
@@ -137,6 +158,14 @@ class TelemetryServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- route bodies (handler calls back in) ---------------------------
+
+    @property
+    def registry(self) -> MetricRegistry:
+        """The registry this scrape serves — resolved per access when
+        constructed with a provider, so `/metrics` and `/varz` always
+        see the freshest merge."""
+        src = self._registry_source
+        return src() if callable(src) else src
 
     def health(self) -> Dict[str, Any]:
         if self.health_fn is None:
@@ -212,11 +241,22 @@ class TelemetryServer:
 
 
 def start_exporter(
-    registry: MetricRegistry, *, port: int = 0, engine=None, **kw
+    registry=None, *, port: int = 0, engine=None, router=None, **kw
 ) -> TelemetryServer:
     """One-call convenience: start a `TelemetryServer`, wiring
-    `engine_health` automatically when an engine is passed. Returns
-    the started server (read ``.port`` / ``.url``)."""
-    if engine is not None and "health_fn" not in kw:
+    `engine_health` automatically when an engine is passed, or the
+    whole fleet surface when a `ReplicaRouter` is passed — merged
+    per-scrape registry (``router.merged_registry`` as the zero-arg
+    provider), `fleet_health` on `/healthz` (503 only with no healthy
+    replica), and per-replica detail on `/varz` (``router.varz``).
+    Returns the started server (read ``.port`` / ``.url``)."""
+    if router is not None:
+        if registry is None:
+            registry = router.merged_registry
+        kw.setdefault("health_fn", fleet_health(router))
+        kw.setdefault("varz_fn", router.varz)
+    elif engine is not None and "health_fn" not in kw:
         kw["health_fn"] = engine_health(engine)
+    if registry is None:
+        raise ValueError("pass a registry/provider, or router=...")
     return TelemetryServer(registry, port=port, **kw).start()
