@@ -346,7 +346,8 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
                 page_size: int = 0, kv_dtype: str = "",
                 shared_prefix: bool = False, spec_k: int = -1,
                 chaos: int = -1, slo: bool = False,
-                metrics_port: int = -1, replicas: int = 0):
+                metrics_port: int = -1, replicas: int = 0,
+                tp: int = 0, disagg: bool = False):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -434,6 +435,34 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     completion streams. ``--metrics-port=N`` here stands the exporter
     up over the ROUTER (zero-arg merged-registry provider, fleet
     `/healthz`) and self-scrapes it.
+
+    ``--tp=N`` A/Bs the tensor-parallel paged serve at EQUAL CHIP
+    COUNT: the same mixed workload runs on a tp=1 engine (1 chip) and
+    on a tp=N engine whose params are sliced from the SAME tp=1
+    checkpoint (`inference.shard_tp1_params`), each still ONE fused
+    mixed trace per tick. Greedy tokens are asserted IDENTICAL and the
+    per-chip KV bytes exactly 1/N (the pools shard over heads).
+    Reports ``gpt_serve_tokens_per_sec_per_chip_tpN`` (fleet rate / N
+    chips; vs_baseline = per-chip ratio over tp=1 — below 1.0 on CPU
+    where the simulated mesh buys no real bandwidth, the per-chip KV
+    headroom is the win) and ``gpt_serve_ttft_ms_tpN``. Needs N
+    visible devices (CPU: ``--xla_force_host_platform_device_count``).
+
+    ``--disagg`` A/Bs disaggregated prefill/decode serving at EQUAL
+    CHIP COUNT: a ``replica_classes=["prefill", "decode", ...]`` fleet
+    (half prefill, half decode; ``--replicas=N`` sizes it, default 2)
+    against an identical-replica fleet on the same workload. Fresh
+    prompts chunk on prefill replicas, finished prompts migrate WITH
+    their KV pages (page-shipping, no re-prefill) to decode replicas.
+    Greedy tokens are asserted IDENTICAL to the uniform fleet, at
+    least one handoff must actually ship pages, and both fleets must
+    drain leak-free. Reports
+    ``gpt_serve_tokens_per_sec_per_chip_disagg`` (vs_baseline =
+    disagg / uniform fleet rate) plus per-class TTFT p95 under
+    ``gpt_serve_ttft_ms_prefill`` / ``_decode`` (vs_baseline = uniform
+    fleet p95 / class p95), attributed to the replica class that
+    FINISHED each request — the decode-class line is the
+    time-to-first-token the fleet's decode capacity actually delivers.
 
     ``--spec-k=K`` A/Bs speculative decoding (n-gram self-drafting
     through the mixed step, `inference/drafting.py`) against the
@@ -722,6 +751,203 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
             )
         finally:
             srv.close()
+
+    if tp >= 2:
+        # ---- equal-chip-count tensor-parallel A/B: tp=1 on 1 chip vs
+        # tp=N on N chips, SAME checkpoint, SAME workload. The tokens
+        # must not move; the per-chip KV footprint must drop 1/N.
+        import dataclasses
+
+        from rocm_apex_tpu.inference import shard_tp1_params
+        from rocm_apex_tpu.transformer import parallel_state
+
+        if len(jax.devices()) < tp:
+            raise SystemExit(
+                f"--tp={tp} needs {tp} visible devices, have "
+                f"{len(jax.devices())} (CPU: set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={tp})"
+            )
+        ekw = dict(
+            num_slots=num_slots, capacity=capacity,
+            sampling=SamplingParams(temperature=0.0), seed=0,
+            prefill_token_budget=budget, paged=True,
+            page_size=page_size or (64 if on_tpu else 16),
+        )
+
+        def run_tp(m, p):
+            eng = InferenceEngine(m, p, **ekw)
+            eng.generate(prompts[:num_slots], max_new_tokens=3)
+            eng.reset_stats()
+            t0 = time.perf_counter()
+            results = eng.generate(prompts, max_new_tokens=max_new)
+            dt = time.perf_counter() - t0
+            gen = sum(len(r.tokens) for r in results)
+            return eng, [r.tokens for r in results], gen / dt, dt
+
+        eng1, toks1, rate1, _ = run_tp(model, params)
+        assert eng1.mixed_trace_count == 1
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            tp, 1, devices=jax.devices()[:tp]
+        )
+        model_tp = GPTModel(
+            dataclasses.replace(cfg, tensor_parallel_size=tp)
+        )
+        params_tp = shard_tp1_params(model_tp, params, mesh)
+        eng_t, toks_t, rate_t, dt_t = run_tp(model_tp, params_tp)
+        assert eng_t.mixed_trace_count == 1, (
+            f"tp={tp} mixed step traced {eng_t.mixed_trace_count}x"
+        )
+        assert toks1 == toks_t, (
+            f"tp={tp} serve changed greedy tokens"
+        )
+        kv1, kvt = eng1.per_chip_kv_bytes(), eng_t.per_chip_kv_bytes()
+        assert kvt * tp == kv1, (
+            f"per-chip KV bytes {kvt} x{tp} != tp=1 {kv1}"
+        )
+        s1, s_t = eng1.stats(), eng_t.stats()
+        chip_rate = rate_t / tp
+        print(
+            f"serve[tp{tp}]: {rate_t:.1f} gen tok/s over {dt_t:.2f}s "
+            f"= {chip_rate:.1f}/chip vs tp1 {rate1:.1f}/chip "
+            f"({chip_rate / rate1:.2f}x); tokens identical; per-chip "
+            f"KV {kvt / 2**20:.1f} MiB vs {kv1 / 2**20:.1f} MiB "
+            f"(1/{tp}); ttft p95 {s_t['ttft_ms_p95']:.0f} vs "
+            f"{s1['ttft_ms_p95']:.0f} ms",
+            file=sys.stderr,
+        )
+        _report(
+            f"gpt_serve_tokens_per_sec_per_chip_tp{tp}", chip_rate,
+            "tokens/s", chip_rate / rate1,
+            f"tp={tp} paged serve at equal chip count vs tp=1 "
+            f"{rate1:.1f} tok/s/chip (ratio = vs_baseline); greedy "
+            f"tokens identical, mixed step traced once, per-chip KV "
+            f"bytes exactly 1/{tp}",
+        )
+        _report(
+            f"gpt_serve_ttft_ms_tp{tp}", s_t["ttft_ms_p95"], "ms",
+            s1["ttft_ms_p95"] / max(s_t["ttft_ms_p95"], 1e-9),
+            f"enqueue->first-token p95 at tp={tp} vs tp=1 "
+            f"{s1['ttft_ms_p95']:.0f} ms (ratio = vs_baseline)",
+        )
+        parallel_state.destroy_model_parallel()
+        return
+
+    if disagg:
+        # ---- equal-chip-count disaggregation A/B: a prefill/decode
+        # class fleet vs an identical-replica fleet, same chips, same
+        # workload. Placement and page-shipping handoffs must be
+        # invisible in tokens; the per-class TTFT split is the point.
+        from rocm_apex_tpu.inference import ReplicaRouter
+
+        n_rep = replicas if replicas >= 2 else 2
+        classes = (
+            ["prefill"] * (n_rep // 2)
+            + ["decode"] * (n_rep - n_rep // 2)
+        )
+        # disaggregation amortizes one page-shipping handoff per
+        # request over the DECODE phase: measure the decode-heavy
+        # regime it exists for (the mixed workload's 6-token CPU tail
+        # would be all handoff, no decode)
+        dis_new = max_new if on_tpu else max_new * 8
+        ekw = dict(
+            num_slots=num_slots, capacity=capacity,
+            max_prompt_len=max(lens),
+            sampling=SamplingParams(temperature=0.0), seed=0,
+            prefill_token_budget=budget, paged=True,
+            page_size=page_size or (64 if on_tpu else 16),
+        )
+
+        def run_fleet(fleet_classes):
+            router = ReplicaRouter(
+                model, params, replicas=n_rep,
+                engine_kwargs=dict(ekw),
+                replica_classes=fleet_classes,
+            )
+            for i in range(router.num_replicas):
+                router.replica(i).generate(
+                    prompts[:num_slots], max_new_tokens=3
+                )
+                router.replica(i).reset_stats()
+            t0 = time.perf_counter()
+            results = router.generate(prompts, max_new_tokens=dis_new)
+            dt = time.perf_counter() - t0
+            gen = sum(len(r.tokens) for r in results)
+            return router, results, gen / dt, dt
+
+        # throwaway disagg pass: the page-ship import scatters compile
+        # lazily on first handoff (one program per shipped-page count)
+        # — warm jax's global jit cache so the timed passes measure
+        # the serving fabric, not XLA
+        run_fleet(classes)
+        router_u, res_u, rate_u, _ = run_fleet(None)
+        router_d, res_d, rate_d, dt_d = run_fleet(classes)
+        assert [r.tokens for r in res_d] == [r.tokens for r in res_u], (
+            "disagg fleet tokens diverged from the uniform fleet"
+        )
+        s_d = router_d.stats()
+        assert s_d["handoffs"] >= 1, s_d
+        assert s_d["page_migrations"] >= 1, s_d
+        ships = 0
+        for i in range(n_rep):
+            rep = router_d.replica(i)
+            ships += int(rep.stats().get("page_ships", 0))
+            assert rep.num_active == 0 and rep.pages_used == 0, (
+                f"disagg replica {i} leaked slots/pages"
+            )
+            rep._allocator.assert_consistent()
+        assert ships >= 1, "no handoff actually shipped pages"
+        # per-class TTFT p95 from the per-replica completion records,
+        # attributed (like the router_ttft_ms histogram) to the class
+        # of the replica that FINISHED the request
+        ttft_all = [
+            c["ttft_ms"]
+            for i in range(n_rep)
+            for c in router_u.replica(i).completions
+            if c["ttft_ms"] > 0
+        ]
+        p95_u = float(np.percentile(ttft_all, 95)) if ttft_all else 0.0
+        by_class = {}
+        for i, c in enumerate(classes):
+            by_class.setdefault(c, []).extend(
+                rec["ttft_ms"]
+                for rec in router_d.replica(i).completions
+                if rec["ttft_ms"] > 0
+            )
+        chip_u, chip_d = rate_u / n_rep, rate_d / n_rep
+        class_p95 = {
+            c: float(np.percentile(v, 95))
+            for c, v in by_class.items() if v
+        }
+        per_class = ", ".join(
+            f"{c} p95={v:.0f}ms" for c, v in sorted(class_p95.items())
+        )
+        print(
+            f"serve[disagg x{n_rep}]: {rate_d:.1f} gen tok/s "
+            f"({chip_d:.1f}/chip) over {dt_d:.2f}s vs uniform "
+            f"{rate_u:.1f} ({rate_d / rate_u:.2f}x); tokens identical; "
+            f"{int(s_d['handoffs'])} handoffs, {ships} page ships; "
+            f"ttft {per_class} vs uniform p95={p95_u:.0f}ms",
+            file=sys.stderr,
+        )
+        _report(
+            "gpt_serve_tokens_per_sec_per_chip_disagg", chip_d,
+            "tokens/s", rate_d / rate_u,
+            f"prefill/decode class fleet ({'+'.join(classes)}) vs "
+            f"uniform x{n_rep} at equal chip count "
+            f"(ratio = vs_baseline); tokens identical, "
+            f"{int(s_d['handoffs'])} handoffs shipped {ships} page "
+            f"payloads, both fleets leak-free",
+        )
+        for c, v in sorted(class_p95.items()):
+            _report(
+                f"gpt_serve_ttft_ms_{c}", v, "ms",
+                p95_u / max(v, 1e-9),
+                f"ttft p95 of requests FINISHED by {c}-class replicas "
+                f"vs uniform-fleet p95 {p95_u:.0f} ms "
+                f"(ratio = vs_baseline)",
+            )
+        return
 
     if replicas >= 2:
         from rocm_apex_tpu.inference import Fault, FaultPlan, ReplicaRouter
@@ -2287,6 +2513,10 @@ if __name__ == "__main__":
             kwargs["metrics_port"] = int(a.split("=", 1)[1])
         elif a.startswith("--replicas="):
             kwargs["replicas"] = int(a.split("=", 1)[1])
+        elif a.startswith("--tp="):
+            kwargs["tp"] = int(a.split("=", 1)[1])
+        elif a == "--disagg":
+            kwargs["disagg"] = True
         elif a == "--dist-opt":
             kwargs["dist_opt"] = True
         elif a.startswith("--comm-dtype="):
@@ -2330,11 +2560,32 @@ if __name__ == "__main__":
         or "shared_prefix" in kwargs or "spec_k" in kwargs
         or "chaos" in kwargs or "slo" in kwargs
         or "metrics_port" in kwargs or "replicas" in kwargs
+        or "tp" in kwargs or "disagg" in kwargs
     ) and which != "serve":
         raise SystemExit(
             "--budget/--whole-prompt/--trace/--paged/--page-size/"
             "--kv-dtype/--shared-prefix/--spec-k/--chaos/--slo/"
-            "--metrics-port/--replicas apply to the serve bench"
+            "--metrics-port/--replicas/--tp/--disagg apply to the "
+            "serve bench"
+        )
+    if kwargs.get("tp", 2) < 2:
+        raise SystemExit("--tp takes a tensor-parallel width N >= 2")
+    if "tp" in kwargs and any(
+        k not in ("tp", "budget", "page_size") for k in kwargs
+    ):
+        raise SystemExit(
+            "--tp runs its own equal-chip-count paged A/B; it "
+            "composes with --budget/--page-size only"
+        )
+    if kwargs.get("disagg") and any(
+        k in kwargs
+        for k in ("whole_prompt", "shared_prefix", "spec_k", "chaos",
+                  "slo", "metrics_port", "trace", "paged", "kv_dtype",
+                  "tp")
+    ):
+        raise SystemExit(
+            "--disagg runs its own equal-chip-count fleet A/B; it "
+            "composes with --replicas/--budget/--page-size only"
         )
     if kwargs.get("spec_k", 0) < 0:
         raise SystemExit("--spec-k must be >= 0")

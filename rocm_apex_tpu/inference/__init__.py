@@ -29,11 +29,14 @@ nothing is forked:
                path), donated cache buffers, no prompt-length ceiling;
                ``paged=True`` swaps in the block-table cache
     router     multi-replica serving fabric: `ReplicaRouter` owns N
-               engines behind one surface — prefix-affinity +
-               least-loaded placement, replica failover with
-               token-identical in-flight recovery (prompt + emitted
-               tokens is the migration format), rolling drain/rejoin,
-               fleet chaos sites, merged fleet telemetry
+               engines behind one surface — prefix-affinity placement
+               via the cross-replica `SharedPrefixRegistry`,
+               least-loaded otherwise, replica failover with
+               token-identical in-flight recovery (page-shipping
+               migration on paged caches, prompt + emitted tokens as
+               the replay fallback), disaggregated prefill/decode
+               replica classes with per-class TTFT/TPOT, rolling
+               drain/rejoin, fleet chaos sites, merged fleet telemetry
 
 The model side lives in `models/gpt.py` (``cache=`` on `GPTModel`) and
 `ops/flash_attention.py` (`flash_attention_decode`); this package owns
@@ -47,6 +50,7 @@ from rocm_apex_tpu.inference.engine import (  # noqa: F401
     InferenceEngine,
     Request,
     SamplingParams,
+    shard_tp1_params,
 )
 from rocm_apex_tpu.inference.faults import (  # noqa: F401
     NO_FAULTS,
@@ -61,8 +65,10 @@ from rocm_apex_tpu.inference.paging import (  # noqa: F401
     PrefixStore,
 )
 from rocm_apex_tpu.inference.router import (  # noqa: F401
+    REPLICA_CLASSES,
     REPLICA_STATES,
     ReplicaRouter,
+    SharedPrefixRegistry,
 )
 from rocm_apex_tpu.inference.sampling import (  # noqa: F401
     greedy,
@@ -78,7 +84,10 @@ __all__ = [
     "PrefixStore",
     "InferenceEngine",
     "ReplicaRouter",
+    "SharedPrefixRegistry",
     "REPLICA_STATES",
+    "REPLICA_CLASSES",
+    "shard_tp1_params",
     "NGramDrafter",
     "Fault",
     "FaultPlan",

@@ -72,7 +72,84 @@ __all__ = [
     "GenerationResult",
     "InferenceEngine",
     "FINISH_REASONS",
+    "shard_tp1_params",
 ]
+
+
+def shard_tp1_params(model, params_tp1, mesh, sample_tokens=None):
+    """Slice a tp=1 params pytree into the fake-replicated tp layout.
+
+    The tensor-parallel layers draw INDEPENDENT per-rank values at
+    init (rank-folded keys), so a tp>1 model initialized from the same
+    seed does NOT compute the tp=1 function. Serving wants exactly
+    that function: this helper takes the tp=1 checkpoint and, for each
+    leaf, finds the one axis the tp model shards (by comparing against
+    the tp model's abstract init shapes), slices the tp=1 weight into
+    per-rank shards, and lays them out in the repo's fake-replicated
+    idiom — global shape == local shape, each mesh device holding its
+    own rank's slice (`check_rep=False` downstream). Replicated leaves
+    (LayerNorms, position embeddings, biases of row-parallel layers)
+    pass through unchanged on every rank.
+
+    ``model`` is the tp>1 module (its cfg names the tensor axis and
+    world size); ``mesh`` the initialized `parallel_state` mesh. The
+    returned pytree is committed to the mesh devices, ready for
+    `InferenceEngine(model, params)` or a training step.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    P = jax.sharding.PartitionSpec
+    axis = model.cfg.tensor_axis
+    tp = mesh.shape[axis]
+    if sample_tokens is None:
+        sample_tokens = jnp.zeros((1, 8), jnp.int32)
+
+    local_shapes = jax.eval_shape(
+        shard_map(
+            lambda t: model.init(jax.random.PRNGKey(0), t),
+            mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_rep=False,
+        ),
+        sample_tokens,
+    )
+
+    def _stack(full, local):
+        full_np = np.asarray(full)
+        gshape, lshape = tuple(full_np.shape), tuple(local.shape)
+        if gshape == lshape:
+            return np.stack([full_np] * tp)
+        diff = [
+            i for i, (g, l) in enumerate(zip(gshape, lshape)) if g != l
+        ]
+        if len(gshape) != len(lshape) or len(diff) != 1 or any(
+            gshape[i] != lshape[i] * tp for i in diff
+        ):
+            raise ValueError(
+                f"cannot map tp=1 leaf {gshape} onto tp={tp} local "
+                f"shape {lshape}"
+            )
+        ax = diff[0]
+        return np.stack(
+            np.split(full_np, tp, axis=ax)
+        )
+
+    stacked = jax.tree_util.tree_map(_stack, params_tp1, local_shapes)
+
+    def _pick(tree):
+        r = jax.lax.axis_index(axis)
+        return jax.tree_util.tree_map(
+            lambda s: jax.lax.dynamic_index_in_dim(
+                s, r, 0, keepdims=False
+            ),
+            tree,
+        )
+
+    return jax.jit(
+        shard_map(
+            _pick, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_rep=False,
+        )
+    )(stacked)
 
 #: every finish_reason a `GenerationResult` can carry — the lifecycle
 #: contract documented in docs/inference.md "Failure semantics"
@@ -201,9 +278,18 @@ class InferenceEngine:
     (TTFT collapses for shared-system-prompt traffic) and pages fork
     copy-on-write only when the borrower would write into one.
 
-    Single-chip (tp=1) in this PR; the cache layout already stores
-    LOCAL head shards, so multi-chip sharded serving is a cache-
-    compatible follow-up.
+    Multi-chip serving (``cfg.tensor_parallel_size > 1``; requires
+    ``paged=True`` + chunked mode and an initialized
+    `parallel_state` mesh): every step program runs under one
+    `shard_map` over the tensor axis. The packed prefill chunk rides
+    the sequence-parallel + collective-matmul layout (each chip holds
+    ``budget/tp`` rows between the embedding scatter and the LM-head
+    gather; TP-edge collectives fuse into ppermute rings), the decode
+    grid stays plain tensor-parallel, and the paged pools keep GLOBAL
+    heads laid out head-sharded (`NamedSharding`) so per-chip KV bytes
+    drop by 1/tp (`per_chip_kv_bytes`) while host fetches — page
+    shipping, debugging — see full-head arrays. Greedy outputs are
+    token-identical to a tp=1 engine and ``mixed_trace_count`` stays 1.
 
     Robustness layer (docs/inference.md "Failure semantics"): per-
     request deadlines/queue TTLs (``add_request(timeout=, queue_ttl=)``,
@@ -261,11 +347,53 @@ class InferenceEngine:
         step_source: Optional["InferenceEngine"] = None,
     ):
         cfg = model.cfg
-        if (cfg.tensor_parallel_size or 1) > 1:
-            raise NotImplementedError(
-                "multi-chip serving (tp > 1) is a future PR; build the "
-                "engine with tensor_parallel_size=1"
-            )
+        tp = int(cfg.tensor_parallel_size or 1)
+        self.tp = tp
+        self._mesh = None
+        if tp > 1:
+            # Multi-chip serving: the fused mixed step runs under
+            # shard_map over the tensor axis. The packed chunk rides
+            # the PR-3 sequence-parallel layout (ring collectives from
+            # ops/collective_matmul.py); the decode grid stays plain
+            # tensor-parallel (its width-1 seq axis cannot shard); the
+            # paged pools are laid out head-sharded so per-chip KV
+            # bytes drop by 1/tp (see _cache_pspec).
+            from rocm_apex_tpu.transformer import parallel_state
+
+            if not parallel_state.model_parallel_is_initialized():
+                raise ValueError(
+                    "tp>1 serving needs parallel_state."
+                    "initialize_model_parallel(tp, 1) before engine "
+                    "construction (the shard_map mesh comes from it)"
+                )
+            if parallel_state.get_tensor_model_parallel_world_size() != tp:
+                raise ValueError(
+                    f"model cfg.tensor_parallel_size={tp} but the "
+                    f"initialized mesh has tensor size "
+                    f"{parallel_state.get_tensor_model_parallel_world_size()}"
+                )
+            self._mesh = parallel_state.get_mesh()
+            if not paged:
+                raise ValueError(
+                    "tp>1 serving shards the PagedKVCache pools over "
+                    "heads; set paged=True"
+                )
+            if prefill_token_budget is None:
+                raise ValueError(
+                    "tp>1 serving rides the chunked mixed step; set "
+                    "prefill_token_budget"
+                )
+            if prefill_token_budget % tp != 0:
+                raise ValueError(
+                    f"prefill_token_budget={prefill_token_budget} must "
+                    f"divide by tp={tp} (the chunk stream is "
+                    f"sequence-scattered over the tensor axis)"
+                )
+            if cfg.num_attention_heads % tp != 0:
+                raise ValueError(
+                    f"num_attention_heads={cfg.num_attention_heads} "
+                    f"must divide by tp={tp}"
+                )
         self.model = model
         self.params = params
         self.capacity = int(capacity or cfg.max_position_embeddings)
@@ -335,6 +463,11 @@ class InferenceEngine:
         # preempted-request carryover: request_id -> (generated tokens,
         # first_token_at, chunk count) restored on re-admission
         self._preempted: Dict[int, Any] = {}
+        # page-shipping migration: payloads handed to resume_request(pages=...)
+        # wait here until the request leases a slot; fallbacks replay tokens
+        self._shipped: Dict[int, Any] = {}
+        self._page_ships = 0
+        self._page_ship_fallbacks = 0
         # speculative-decoding accounting: every drafted token ends up
         # either accepted (emitted) or rolled back
         self._tokens_drafted = 0
@@ -367,7 +500,17 @@ class InferenceEngine:
                     else cache_dtype
                 ),
                 quantized=quantized,
+                # tp>1: GLOBAL head count in the pools; the NamedSharding
+                # below splits dim 1 (heads) over the tensor axis, so
+                # each chip physically holds 1/tp of the KV bytes while
+                # host fetches (page shipping, debugging) still see
+                # full-head arrays — shipped pages are tp-agnostic.
+                full_heads=(tp > 1),
             )
+            if tp > 1:
+                self.cache = jax.device_put(
+                    self.cache, self._cache_sharding()
+                )
             self._allocator = PageAllocator(self.cache.num_pages)
             if prefix_sharing:
                 self._store = PrefixStore(page_size)
@@ -531,6 +674,47 @@ class InferenceEngine:
 
         sp = self.sampling
 
+        # Model variants for the tp>1 split: the CHUNK apply rides the
+        # sequence-parallel + collective-matmul layout (the packed
+        # stream scatters to (1, budget/tp, h) rows per chip and the
+        # TP-edge collectives fuse into ppermute rings), while the
+        # DECODE apply keeps plain tensor parallelism (a width-1 seq
+        # axis cannot be sequence-sharded). sequence_parallel changes
+        # ZERO parameter shapes, so both variants consume the same
+        # params pytree; at tp=1 both are the caller's model.
+        decode_model = model
+        chunk_model = model
+        if tp > 1:
+            chunk_model = type(model)(
+                cfg=dataclasses.replace(
+                    cfg, sequence_parallel=True, collective_matmul=True
+                )
+            )
+            if cfg.sequence_parallel:
+                decode_model = type(model)(
+                    cfg=dataclasses.replace(
+                        cfg, sequence_parallel=False,
+                        collective_matmul=False,
+                    )
+                )
+
+        if tp > 1:
+            from rocm_apex_tpu.transformer.tensor_parallel import mappings
+
+            tensor_axis = cfg.tensor_axis
+
+            def _full_logits(logits):
+                # the tied head returns VOCAB-PARALLEL logits
+                # (..., vocab/tp); sampling needs the full vocab row.
+                # The gather is replicated-in, replicated-out, so the
+                # sample below is bit-identical on every rank.
+                return mappings.gather_from_tensor_model_parallel_region(
+                    logits, tensor_axis
+                )
+        else:
+            def _full_logits(logits):
+                return logits
+
         def _sample(rng, logits):
             return sample(
                 rng,
@@ -545,7 +729,7 @@ class InferenceEngine:
             self._traces["prefill"] += 1
             sub = cache.slot_view(slot)
             sub = sub.replace(lengths=jnp.zeros((1,), jnp.int32))
-            logits, sub = model.apply(params, tokens, cache=sub)
+            logits, sub = decode_model.apply(params, tokens, cache=sub)
             # the model advanced by the PADDED width; the live prefix
             # is the real prompt — decode overwrites the pad positions
             # one by one and never attends past `lengths`
@@ -556,7 +740,7 @@ class InferenceEngine:
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], length - 1, 0, keepdims=False
             )
-            first_tok = _sample(rng, last[None, :])[0]
+            first_tok = _sample(rng, _full_logits(last)[None, :])[0]
             return first_tok, cache
 
         is_paged = self.paged
@@ -585,7 +769,7 @@ class InferenceEngine:
                         jnp.full_like(lengths0, dev_capacity),
                     )
                 )
-            logits, new_cache = model.apply(
+            logits, new_cache = decode_model.apply(
                 params, tokens[:, None], cache=cache
             )
             # pin inactive slots' lengths (their dead-row writes drop
@@ -597,7 +781,7 @@ class InferenceEngine:
                     active, new_cache.lengths, lengths0
                 )
             )
-            last = logits[:, -1, :] + poison[:, None]
+            last = _full_logits(logits[:, -1, :]) + poison[:, None]
             bad = jnp.any(~jnp.isfinite(last), axis=-1)
             tok = _sample(rng, last)
             return jnp.where(active, tok, 0), bad, new_cache
@@ -625,12 +809,13 @@ class InferenceEngine:
             self._traces["mixed"] += 1
             rng_c, rng_d = jax.random.split(rng)
             cache = cache.replace(lengths=lengths_before)
-            logits_c, cache = model.apply(
+            logits_c, cache = chunk_model.apply(
                 params,
                 chunk_tokens[None, :],
                 cache=cache,
                 chunk=(chunk_slots, chunk_pos),
             )
+            logits_c = _full_logits(logits_c)
             # sample EVERY chunk position (fixed shape); the host keeps
             # only the positions that completed a prompt this tick.
             # `chunk_poison` follows the decode-grid poison contract:
@@ -675,12 +860,13 @@ class InferenceEngine:
             self._traces["mixed"] += 1
             rng_c, rng_d = jax.random.split(rng)
             cache = cache.replace(lengths=lengths_before)
-            logits_c, cache, chunk_kv = model.apply(
+            logits_c, cache, chunk_kv = chunk_model.apply(
                 params,
                 chunk_tokens[None, :],
                 cache=cache,
                 chunk=(chunk_slots, chunk_pos, commit_slots),
             )
+            logits_c = _full_logits(logits_c)
             # sample EVERY chunk position: for a draft row the sample
             # IS the verifier's token — greedy accepts on equality,
             # and under temperature the sample-vs-draft equality test
@@ -730,6 +916,45 @@ class InferenceEngine:
         self._mixed_fn = _mixed
         self._mixed_spec_fn = _mixed_spec
         self._commit_fn = _commit
+        if tp > 1:
+            # One shard_map per step program, jitted around the whole
+            # region: replicated host inputs (token buffers, masks,
+            # cursors, rng) ride in with P(); the cache rides its
+            # head-sharded spec; params are the repo's fake-replicated
+            # idiom (global shape == local shape, per-rank contents),
+            # so P() hands each rank its own shard. check_rep=False:
+            # the sampled tokens are replicated by construction (the
+            # vocab gather), not by anything the rep checker can see.
+            from jax.experimental.shard_map import shard_map
+
+            P = jax.sharding.PartitionSpec
+            rep = P()
+            cspec = self._cache_pspec()
+            kv_spec = tuple(
+                P(None, cfg.tensor_axis, None) for _ in range(n_layers)
+            )
+            mesh = self._mesh
+
+            def _shmap(f, n_rep_in, out_specs):
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(rep, cspec) + (rep,) * n_rep_in,
+                    out_specs=out_specs,
+                    check_rep=False,
+                )
+
+            _decode = _shmap(_decode, 4, (rep, rep, cspec))
+            _mixed = _shmap(_mixed, 11, (rep, rep, rep, rep, cspec))
+            _mixed_spec = _shmap(
+                _mixed_spec, 12,
+                (rep, rep, rep, rep, cspec, (kv_spec, kv_spec)),
+            )
+            _commit = shard_map(
+                _commit, mesh=mesh,
+                in_specs=(cspec, (kv_spec, kv_spec), rep, rep),
+                out_specs=cspec,
+                check_rep=False,
+            )
         self._prefill_jit = jax.jit(_prefill, donate_argnums=donate)
         self._decode_jit = jax.jit(_decode, donate_argnums=donate)
         self._mixed_jit = jax.jit(_mixed, donate_argnums=donate)
@@ -790,6 +1015,76 @@ class InferenceEngine:
         self._commit_jit = src._commit_jit
         if self.paged:
             self._fork_jit = src._fork_jit
+
+    # ------------------------------------------------------------------
+    # tp>1 cache layout
+    # ------------------------------------------------------------------
+
+    def _cache_pspec(self):
+        """PartitionSpec pytree matching the `PagedKVCache` structure:
+        pools head-sharded over the tensor axis (dim 1 of
+        ``(num_pages, heads, page_size, head_dim)``), int8 scales
+        likewise (dim 1 of ``(num_pages, heads)``), table and lengths
+        replicated. Used both as the shard_map cache spec and (through
+        `_cache_sharding`) as the initial device layout."""
+        P = jax.sharding.PartitionSpec
+        axis = self.model.cfg.tensor_axis
+        n = len(self.cache.k)
+        pool = P(None, axis, None, None)
+        sc = P(None, axis)
+        return PagedKVCache(
+            k=tuple(pool for _ in range(n)),
+            v=tuple(pool for _ in range(n)),
+            k_scale=(
+                None if self.cache.k_scale is None
+                else tuple(sc for _ in range(n))
+            ),
+            v_scale=(
+                None if self.cache.v_scale is None
+                else tuple(sc for _ in range(n))
+            ),
+            page_table=P(),
+            lengths=P(),
+            page_size=self.cache.page_size,
+        )
+
+    def _cache_sharding(self):
+        """`NamedSharding` pytree for `jax.device_put` of the cache."""
+        mesh = self._mesh
+        spec = self._cache_pspec()
+        ns = lambda s: jax.sharding.NamedSharding(mesh, s)
+        n = len(self.cache.k)
+        return PagedKVCache(
+            k=tuple(ns(s) for s in spec.k),
+            v=tuple(ns(s) for s in spec.v),
+            k_scale=(
+                None if spec.k_scale is None
+                else tuple(ns(s) for s in spec.k_scale)
+            ),
+            v_scale=(
+                None if spec.v_scale is None
+                else tuple(ns(s) for s in spec.v_scale)
+            ),
+            page_table=ns(spec.page_table),
+            lengths=ns(spec.lengths),
+            page_size=spec.page_size,
+        )
+
+    def per_chip_kv_bytes(self) -> int:
+        """Physical KV pool + scale bytes held by the most-loaded chip
+        — the 1/tp audit number (a tp=1 engine reports the full pool).
+        Walks `addressable_shards`, so it measures the layout the
+        arrays actually have, not the intended spec."""
+        per_dev: Dict[Any, int] = {}
+        arrays = list(self.cache.k) + list(self.cache.v)
+        for scales in (self.cache.k_scale, self.cache.v_scale):
+            if scales is not None:
+                arrays += list(scales)
+        for a in arrays:
+            for sh in a.addressable_shards:
+                nbytes = sh.data.size * sh.data.dtype.itemsize
+                per_dev[sh.device] = per_dev.get(sh.device, 0) + nbytes
+        return max(per_dev.values()) if per_dev else 0
 
     # ------------------------------------------------------------------
     # public API
@@ -922,7 +1217,9 @@ class InferenceEngine:
         skipped), ``page_stalls`` (tokens deferred by pool
         backpressure), ``preemptions`` (slots whose pages were
         reclaimed under pool deadlock — the request recomputes via
-        chunked prefill on re-admission).
+        chunked prefill on re-admission), ``page_ships`` /
+        ``page_ship_fallbacks`` (migrations that landed their KV
+        payload directly vs fell back to token replay).
 
         Speculative decoding (zeros at ``spec_k == 0``):
         ``tokens_drafted``/``tokens_accepted`` (drafter proposals
@@ -981,6 +1278,8 @@ class InferenceEngine:
             "prefix_hit_tokens": float(self._prefix_hit_tokens),
             "page_stalls": float(self._page_stalls),
             "preemptions": float(self._preemptions),
+            "page_ships": float(self._page_ships),
+            "page_ship_fallbacks": float(self._page_ship_fallbacks),
         }
         return {
             **paged_stats,
@@ -1061,6 +1360,8 @@ class InferenceEngine:
         self._prefix_hit_tokens = 0
         self._page_stalls = 0
         self._preemptions = 0
+        self._page_ships = 0
+        self._page_ship_fallbacks = 0
         self._tokens_drafted = 0
         self._tokens_accepted = 0
         self._rollbacks = 0
@@ -1372,7 +1673,7 @@ class InferenceEngine:
                 _rec(req, [], 0.0, 0)
         return recs
 
-    def evacuate(self) -> List[Dict[str, Any]]:
+    def evacuate(self, ship_pages: bool = False) -> List[Dict[str, Any]]:
         """Hand EVERY owned request off for migration: snapshot
         `outstanding()`, then release all slots and pages and empty
         the queue, leaving the engine provably clean for `reopen()`.
@@ -1381,13 +1682,23 @@ class InferenceEngine:
         migrated request still finishes exactly once, on whichever
         engine ultimately runs it. Store-registered prefix pages park
         (they remain a valid cross-request cache); private pages
-        free. Host bookkeeping only."""
+        free. Host bookkeeping only — except with ``ship_pages=True``
+        on a paged cache, where each slot-held record additionally
+        carries its materialized KV page blocks (``rec["pages"]``, the
+        `_export_slot_pages` payload): feed the whole record to another
+        engine's `resume_request(pages=...)` and the destination skips
+        the recompute prefill, token-identically."""
         recs = self.outstanding()
+        by_id = {rec["request_id"]: rec for rec in recs}
         for slot in range(self.num_slots - 1, -1, -1):
             st = self._slots[slot]
             if st is None:
                 continue
             if self.paged:
+                if ship_pages:
+                    payload = self._export_slot_pages(st, slot)
+                    if payload is not None:
+                        by_id[st.req.request_id]["pages"] = payload
                 self._release_slot_pages(st, slot)
             self._slots[slot] = None
             if self.tracer.enabled:
@@ -1399,8 +1710,71 @@ class InferenceEngine:
             self._push_table()
         self._queue.clear()
         self._preempted.clear()
+        self._shipped.clear()
         self._evacuated += len(recs)
         return recs
+
+    def evacuate_request(
+        self, request_id: int, ship_pages: bool = False,
+    ) -> Optional[Dict[str, Any]]:
+        """Hand off ONE owned request (the disaggregation handoff
+        primitive: a prefill-class replica evacuates a request the
+        moment its prompt is materialized and the router re-lands it on
+        a decode-class replica). Same contract as `evacuate()` scoped
+        to a single request: the returned record — with its KV pages
+        attached when ``ship_pages`` and the request holds a slot — is
+        the caller's to deliver; this engine forgets the request
+        entirely. Returns None when the request is not owned here."""
+        for slot in range(self.num_slots):
+            st = self._slots[slot]
+            if st is None or st.req.request_id != request_id:
+                continue
+            rec: Dict[str, Any] = {
+                "request_id": st.req.request_id,
+                "prompt": list(st.req.prompt),
+                "max_new_tokens": st.req.max_new_tokens,
+                "generated": list(st.generated),
+                "enqueued_at": st.req.enqueued_at,
+                "deadline": st.req.deadline,
+                "queue_deadline": st.req.queue_deadline,
+                "first_token_at": st.first_token_at,
+                "chunks": st.chunks,
+            }
+            if self.paged:
+                if ship_pages:
+                    payload = self._export_slot_pages(st, slot)
+                    if payload is not None:
+                        rec["pages"] = payload
+                self._release_slot_pages(st, slot)
+                self._push_table()
+            self._slots[slot] = None
+            self._evacuated += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "evacuate", track=f"req{request_id}",
+                    slot=slot, generated=len(st.generated),
+                )
+            return rec
+        for i, req in enumerate(self._queue):
+            if req.request_id != request_id:
+                continue
+            carried = self._preempted.pop(request_id, None)
+            generated, first_at, chunks = carried or ([], 0.0, 0)
+            del self._queue[i]
+            self._shipped.pop(request_id, None)
+            self._evacuated += 1
+            return {
+                "request_id": req.request_id,
+                "prompt": list(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "generated": list(generated),
+                "enqueued_at": req.enqueued_at,
+                "deadline": req.deadline,
+                "queue_deadline": req.queue_deadline,
+                "first_token_at": first_at,
+                "chunks": chunks,
+            }
+        return None
 
     def resume_request(
         self,
@@ -1414,6 +1788,7 @@ class InferenceEngine:
         queue_deadline: Optional[float] = None,
         first_token_at: float = 0.0,
         chunks: int = 0,
+        pages: Optional[Dict[str, Any]] = None,
     ) -> int:
         """Admit a request MIGRATED from another engine, carrying the
         tokens it already emitted (an `outstanding()`/`evacuate()`
@@ -1424,7 +1799,17 @@ class InferenceEngine:
         ABSOLUTE (same perf_counter domain): a migrated request keeps
         its original SLA clock. Unlike `add_request`, a full queue
         never sheds a resumed request — it was already admitted once;
-        shedding it here would double-account it."""
+        shedding it here would double-account it.
+
+        ``pages`` (a record's ``rec["pages"]`` from
+        ``evacuate(ship_pages=True)``) upgrades the resume to
+        page-shipping: when the request leases a slot, the payload's
+        KV blocks land directly in this engine's pool and the prefill
+        cursor starts past them — only the final prefix token recomputes.
+        The payload is best-effort: if it cannot be imported (geometry
+        mismatch, pool pressure, or an injected ``page_ship`` fault)
+        admission silently falls back to the token-replay path above,
+        with identical greedy output."""
         if self._draining:
             raise RuntimeError(
                 "engine is draining: admission is closed "
@@ -1462,6 +1847,8 @@ class InferenceEngine:
             self._preempted[request_id] = (
                 list(generated), first_token_at or now, int(chunks),
             )
+        if pages is not None and self.paged:
+            self._shipped[request_id] = pages
         self._queue.append(req)
         if self.tracer.enabled:
             self.tracer.instant(
@@ -1555,10 +1942,150 @@ class InferenceEngine:
         """Sync the host page-table mirror to the device pytree (once
         per tick, only when the mapping changed)."""
         if self._table_dirty:
-            self.cache = self.cache.replace(
-                page_table=jnp.asarray(self._table)
-            )
+            table = jnp.asarray(self._table)
+            if self._mesh is not None:
+                # keep the replacement on the mesh layout (replicated)
+                # so the step pytree never mixes device assignments
+                table = jax.device_put(
+                    table,
+                    jax.sharding.NamedSharding(
+                        self._mesh, jax.sharding.PartitionSpec()
+                    ),
+                )
+            self.cache = self.cache.replace(page_table=table)
             self._table_dirty = False
+
+    def _export_slot_pages(self, st: _Slot, slot: int):
+        """Snapshot the slot's mapped KV pages as a migration payload —
+        the pool IS the transfer format. One batched host fetch pulls
+        the per-layer page blocks (and int8 scale rows) for the pages
+        covering ``st.pos`` materialized rows; the payload plus the
+        `outstanding()` record is everything a destination engine needs
+        to resume without re-prefilling. Pools are head-FULL even at
+        tp>1 (the cache shards a full-head pool over the mesh), so a
+        payload exported at any tp imports at any other tp. Returns
+        None when the slot holds no rows — the caller ships nothing and
+        the request replays."""
+        ps = self.cache.page_size
+        rows = int(st.pos)
+        if rows <= 0:
+            return None
+        sentinel = self.cache.num_pages
+        n = -(-rows // ps)  # ceil: partial last page ships whole
+        pages = [int(p) for p in self._table[slot, :n]]
+        if any(p == sentinel for p in pages):
+            return None
+        idx = jnp.asarray(pages, jnp.int32)
+        payload: Dict[str, Any] = {
+            "rows": rows,
+            "page_size": int(ps),
+            "quantized": bool(self.cache.quantized),
+            "dtype": str(self.cache.k[0].dtype),
+            "k": [pool[idx] for pool in self.cache.k],
+            "v": [pool[idx] for pool in self.cache.v],
+        }
+        if self.cache.quantized:
+            payload["k_scale"] = [s[idx] for s in self.cache.k_scale]
+            payload["v_scale"] = [s[idx] for s in self.cache.v_scale]
+        return jax.device_get(payload)
+
+    def _import_shipped_pages(self, st: _Slot, slot: int, payload) -> bool:
+        """Land a shipped KV payload directly in this engine's pool:
+        allocate destination pages, scatter the page blocks in, map the
+        slot's table rows, and start the cursor past the shipped rows.
+        The LAST prefix token is never trusted from the wire — it
+        replays through the ordinary chunk path so the fused step
+        re-derives the slot's device lengths and decode feed exactly as
+        a replay-resume would (greedy output is identical either way;
+        the rewritten row holds the same values it shipped with).
+
+        Returns False — and counts a fallback — whenever the payload
+        cannot be used verbatim: the ``page_ship`` fault site fires
+        (transfer dropped mid-flight), the geometry disagrees
+        (page_size/dtype/quantization/pool shape), or the local
+        allocator is out of pages. The caller then simply admits the
+        request on the token-replay path; nothing was mapped, so
+        neither allocator can leak."""
+        track = f"req{st.req.request_id}"
+        if self.faults.enabled and self.faults.fire(
+            "page_ship", tick=self._tick, slot=slot,
+        ) is not None:
+            # injected transfer loss: the payload never arrived —
+            # fall back to replay, exactly like a real dropped ship
+            self._page_ship_fallbacks += 1
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "page_ship_dropped", track=track, slot=slot,
+                )
+            return False
+        cache = self.cache
+        ps = cache.page_size
+        rows = int(payload.get("rows", 0))
+        target = min(rows, len(st.prefix) - 1)
+        if target <= 0:
+            return False
+        k_bufs = payload.get("k", ())
+        v_bufs = payload.get("v", ())
+        compatible = (
+            int(payload.get("page_size", -1)) == ps
+            and bool(payload.get("quantized")) == cache.quantized
+            and payload.get("dtype") == str(cache.k[0].dtype)
+            and len(k_bufs) == cache.num_layers
+            and len(v_bufs) == cache.num_layers
+            and all(
+                tuple(b.shape[1:]) == tuple(cache.k[0].shape[1:])
+                for b in list(k_bufs) + list(v_bufs)
+            )
+        )
+        n = len(k_bufs[0]) if compatible else 0
+        if not compatible or n < -(-rows // ps) or n > cache.pages_per_slot:
+            self._page_ship_fallbacks += 1
+            return False
+        got = self._allocator.alloc(n)
+        if got is None:
+            # pool pressure at admission: replaying is strictly better
+            # than holding the slot hostage waiting for pages
+            self._page_ship_fallbacks += 1
+            return False
+        dst = jnp.asarray(got, jnp.int32)
+        k = tuple(
+            pool.at[dst].set(jnp.asarray(buf))
+            for pool, buf in zip(cache.k, k_bufs)
+        )
+        v = tuple(
+            pool.at[dst].set(jnp.asarray(buf))
+            for pool, buf in zip(cache.v, v_bufs)
+        )
+        k_scale, v_scale = cache.k_scale, cache.v_scale
+        if cache.quantized:
+            k_scale = tuple(
+                s.at[dst].set(jnp.asarray(buf))
+                for s, buf in zip(cache.k_scale, payload["k_scale"])
+            )
+            v_scale = tuple(
+                s.at[dst].set(jnp.asarray(buf))
+                for s, buf in zip(cache.v_scale, payload["v_scale"])
+            )
+        self.cache = cache.replace(
+            k=k, v=v, k_scale=k_scale, v_scale=v_scale,
+        )
+        if self._mesh is not None:
+            # eager scatters may drop the head sharding; restore the
+            # canonical layout so the donated step inputs stay put
+            self.cache = jax.device_put(
+                self.cache, self._cache_sharding()
+            )
+        for i, page in enumerate(got):
+            self._map_page(slot, i, page)
+        st.cursor = target
+        st.pos = target
+        self._page_ships += 1
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "page_ship_import", track=track, slot=slot,
+                pages=n, rows=target,
+            )
+        return True
 
     def _ensure_writable(self, st: _Slot, slot: int, idx: int) -> bool:
         """Page index ``idx`` of ``slot`` is mapped and privately
@@ -1772,6 +2299,19 @@ class InferenceEngine:
                     st.prefix = list(req.prompt) + list(generated[:-1])
                     st.resumed = True
             self._slots[slot] = st
+            shipped = self._shipped.pop(req.request_id, None)
+            if shipped is not None and self._import_shipped_pages(
+                st, slot, shipped
+            ):
+                # page-shipping landed: the cursor already covers the
+                # shipped rows, which is at least what a local prefix
+                # match could offer — skip the store consult entirely
+                if self.tracer.enabled:
+                    self.tracer.add_span(
+                        "queue_wait", req.enqueued_at, now,
+                        track=f"req{req.request_id}", slot=slot,
+                    )
+                continue
             if self._store is not None:
                 pages, matched, partial, key = self._store.match(
                     req.prompt

@@ -23,6 +23,12 @@ Sites the engine threads through (see `InferenceEngine`):
 ``host_fetch``
     Raises `FaultInjected` between the device call and the value
     fetch — same retry path, different failure point.
+``page_ship``
+    Drops a page-shipping migration payload at import time (as if the
+    KV transfer was lost mid-flight): the destination engine falls back
+    to token-replay recovery — token-identical, just slower — and the
+    already-released source pages simply stay freed, so neither
+    allocator can leak.
 
 Replica-scoped sites the `ReplicaRouter` consults (the ``payload``
 names the target: ``{"replica": i}``; the router consults each site
@@ -68,7 +74,7 @@ __all__ = ["Fault", "FaultPlan", "FaultInjected", "NO_FAULTS", "SITES"]
 #: (per engine tick); the ``replica_*`` sites by the `ReplicaRouter`
 #: (per router tick, payload ``{"replica": i}``).
 SITES = (
-    "page_alloc", "device_step", "logits", "host_fetch",
+    "page_alloc", "device_step", "logits", "host_fetch", "page_ship",
     "replica_kill", "replica_stall", "replica_slow",
 )
 

@@ -215,6 +215,13 @@ class PrefixStore:
         self._by_chain: Dict[Any, _StoreEntry] = {}
         self._children: Dict[Any, Set[_StoreEntry]] = {}
         self._by_page: Dict[int, _StoreEntry] = {}
+        # optional pub/sub hooks, called as ``hook(chain_key, page)``
+        # when a registration appears/disappears in THIS store — the
+        # router's cross-replica SharedPrefixRegistry subscribes here.
+        # Chain keys are pure token tuples, so a subscriber can index
+        # them without holding any store state.
+        self.on_register = None
+        self.on_unregister = None
 
     def __len__(self) -> int:
         return len(self._by_page)
@@ -243,6 +250,8 @@ class PrefixStore:
         self._by_chain[key] = entry
         self._children.setdefault(parent_key, set()).add(entry)
         self._by_page[page] = entry
+        if self.on_register is not None:
+            self.on_register(key, page)
         return key
 
     def chain_key(self, parent_key, tokens: Sequence[int]):
@@ -255,6 +264,8 @@ class PrefixStore:
         if entry is None:
             return
         del self._by_chain[entry.key]
+        if self.on_unregister is not None:
+            self.on_unregister(entry.key, page)
         kids = self._children.get(entry.parent)
         if kids is not None:
             kids.discard(entry)
@@ -352,16 +363,34 @@ class PagedKVCache:
         num_pages: Optional[int] = None,
         dtype: Any = jnp.bfloat16,
         quantized: bool = False,
+        validate_tpu_layout: Optional[bool] = None,
     ) -> "PagedKVCache":
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        pool_dtype = jnp.int8 if quantized else dtype
+        if validate_tpu_layout is None:
+            validate_tpu_layout = jax.default_backend() == "tpu"
+        if validate_tpu_layout:
+            # TPU-silicon constraint (deferred from the paged-kernel
+            # PR): the paged flash kernels DMA (page, head) tiles whose
+            # second-minor dim is page_size, so it must be a sublane
+            # multiple for the pool dtype — 8 rows × 4 bytes packed,
+            # i.e. 8 for fp32, 16 for bf16, 32 for int8. A non-multiple
+            # page relayouts every pool tile on each read.
+            sublanes = 32 // jnp.dtype(pool_dtype).itemsize
+            if page_size % sublanes != 0:
+                raise ValueError(
+                    f"page_size={page_size} is not a sublane multiple "
+                    f"for {jnp.dtype(pool_dtype).name} pools: the TPU "
+                    f"paged kernels need page_size % {sublanes} == 0 "
+                    f"(8 for fp32, 16 for bf16, 32 for int8)"
+                )
         pages_per_slot = -(-capacity // page_size)  # ceil
         if num_pages is None:
             # worst-case default: every slot full — safe, but the
             # memory win comes from sizing num_pages to expected LIVE
             # tokens (see docs/inference.md)
             num_pages = num_slots * pages_per_slot
-        pool_dtype = jnp.int8 if quantized else dtype
         shape = (num_pages, num_heads, page_size, head_dim)
         scales = (
             tuple(
@@ -395,11 +424,17 @@ class PagedKVCache:
         num_pages: Optional[int] = None,
         dtype: Any = None,
         quantized: bool = False,
+        full_heads: bool = False,
     ) -> "PagedKVCache":
         """Paged cache sized for a `GPTConfig`-shaped config (same
         duck-typing as `KVCache.for_model`; heads are the LOCAL
-        per-TP-rank count)."""
-        tp = cfg.tensor_parallel_size or 1
+        per-TP-rank count). ``full_heads=True`` keeps the GLOBAL head
+        count instead — the tp>1 serving engine builds the pools at
+        full heads and lays them out with a head-sharded
+        `NamedSharding`, so each chip holds 1/tp of the heads while
+        host-side fetches still see full-head arrays (which is what
+        makes shipped pages tp-agnostic)."""
+        tp = 1 if full_heads else (cfg.tensor_parallel_size or 1)
         return cls.create(
             cfg.num_layers,
             num_slots,

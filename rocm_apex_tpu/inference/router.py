@@ -7,13 +7,29 @@ fleet behaviours the single engine cannot express:
 
 **Routing & admission.** A bounded global queue feeds per-replica
 admission: each router tick dispatches pending requests to in-rotation
-replicas, prefix-affinity first — the `PrefixStore` chain hash
-(`InferenceEngine.prefix_match_tokens`) routes a prompt to the replica
-already holding its prefix pages, so CoW sharing keeps working across
-the fleet — then least-loaded by the replica's live signals (queue
-depth, slot occupancy, ``pages_used``). Per-replica backlogs stay
-shallow (``replica_queue_depth``) so work left in the GLOBAL queue can
-still be placed anywhere when a replica dies.
+replicas, prefix-affinity first — the `PrefixStore` chain hash routes
+a prompt to the replica already holding its prefix pages via the
+fleet-wide `SharedPrefixRegistry` (each store's register/unregister
+hooks publish its chains, so placement is one chain walk instead of N
+engine consults), so CoW sharing keeps working across the fleet —
+then least-loaded by the replica's live signals (queue depth, slot
+occupancy, ``pages_used``). Per-replica backlogs stay shallow
+(``replica_queue_depth``) so work left in the GLOBAL queue can still
+be placed anywhere when a replica dies.
+
+**Disaggregated prefill/decode (replica classes).** Pass
+``replica_classes=["prefill", "decode", ...]`` and placement
+specializes: fresh prompts land on prefill-class replicas (chunk-heavy
+ticks), and the moment a request's first token is out the prefill
+replica evacuates it WITH its KV pages
+(`InferenceEngine.evacuate_request(ship_pages=True)`) for a
+decode-class replica, which imports the pages directly into its own
+pool — no re-prefill — and runs near-pure decode grids at full
+occupancy. Per-class TTFT/TPOT land in the labeled
+``router_ttft_ms``/``router_tpot_ms`` histogram families. Class
+preference never costs availability: with no decode capacity the
+request keeps decoding where it is, and a failed page import falls
+back to token replay — token-identical either way.
 
 **Failure detection & recovery.** Three detectors run every tick:
 consecutive `step()` failures (device faults, watchdog raises),
@@ -22,10 +38,14 @@ zero-progress probe over `progress_marker` for replicas that have work
 but move no tokens. A replica crossing its threshold is QUARANTINED
 and every request it held is resubmitted to the rest of the fleet as
 prompt + tokens emitted so far — the vLLM recompute transition (arXiv
-2309.06180) generalized to replica death. Continuation is pure greedy
-decode through the destination's chunked prefill (arXiv 2403.02310),
-so recovered outputs are token-identical to an undisturbed run and no
-token is ever emitted twice: the router delivers each request's result
+2309.06180) generalized to replica death. On a paged cache the
+quarantine/drain paths additionally SHIP each slot's KV page blocks
+with the record (``evacuate(ship_pages=True)``): the destination
+imports them straight into its `PageAllocator` and skips the
+recompute. Either way continuation is greedy decode through the
+destination's chunked prefill (arXiv 2403.02310), so recovered
+outputs are token-identical to an undisturbed run and no token is
+ever emitted twice: the router delivers each request's result
 exactly once (`_deliver` enforces it). For `replica_kill` the engine's
 state is presumed LOST — recovery reads the router's own per-request
 token mirror (refreshed from `outstanding()` after every successful
@@ -66,20 +86,91 @@ from rocm_apex_tpu.inference.engine import (
 from rocm_apex_tpu.inference.faults import NO_FAULTS, FaultPlan
 from rocm_apex_tpu.monitor.trace import NULL_TRACER
 
-__all__ = ["ReplicaRouter", "REPLICA_STATES"]
+__all__ = [
+    "ReplicaRouter", "SharedPrefixRegistry", "REPLICA_STATES",
+    "REPLICA_CLASSES",
+]
 
 #: Replica rotation states: ``up`` serves traffic; ``quarantined`` was
 #: failed out and awaits a rejoin probe; ``drained`` was rolled out on
 #: purpose (`drain_replica`) and waits for `rejoin_replica`.
 REPLICA_STATES = ("up", "quarantined", "drained")
 
+#: Replica placement classes: ``mixed`` takes anything (the default —
+#: a classic homogeneous fleet); ``prefill`` prefers fresh prompts and
+#: hands each request off (with its KV pages) once its first token is
+#: out; ``decode`` prefers carried requests — pure decode grids at
+#: full occupancy.
+REPLICA_CLASSES = ("mixed", "prefill", "decode")
+
+
+class SharedPrefixRegistry:
+    """Cross-replica index of materialized prefix chains.
+
+    Each replica's `PrefixStore` keys pages by the pure chain hash
+    ``(parent_key, page tokens)`` — a value any party can recompute
+    from the tokens alone, no store needed. This registry subscribes to
+    every store's register/unregister hooks and maintains
+    ``chain key -> {replica indices holding that chain}``, so placement
+    answers "who already holds this prompt's prefix pages?" with one
+    O(prompt pages) walk instead of consulting N engines per request.
+    Host bookkeeping only; the stores remain the page owners — the
+    registry never pins a page."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._holders: Dict[Any, set] = {}
+
+    def __len__(self) -> int:
+        return len(self._holders)
+
+    def publish(self, replica: int, key) -> None:
+        self._holders.setdefault(key, set()).add(replica)
+
+    def unpublish(self, replica: int, key) -> None:
+        holders = self._holders.get(key)
+        if holders is None:
+            return
+        holders.discard(replica)
+        if not holders:
+            del self._holders[key]
+
+    def holders(self, key) -> frozenset:
+        return frozenset(self._holders.get(key, ()))
+
+    def best(self, prompt: Sequence[int]) -> Dict[int, int]:
+        """``replica index -> matched prefix tokens`` over the full
+        pages of ``prompt`` (leaving >= 1 token unmatched, the store's
+        own contract). Chain containment makes per-replica matches
+        contiguous, so each replica's entry is simply the deepest
+        chain it still holds."""
+        ps = self.page_size
+        limit = len(prompt) - 1
+        key = None
+        m = 0
+        matched: Dict[int, int] = {}
+        while m + ps <= limit:
+            key = (key, tuple(int(t) for t in prompt[m:m + ps]))
+            holders = self._holders.get(key)
+            if not holders:
+                break
+            m += ps
+            for idx in holders:
+                matched[idx] = m
+        return matched
+
 
 class _Replica:
     """Router-side bookkeeping for one engine."""
 
-    def __init__(self, index: int, engine: InferenceEngine):
+    def __init__(
+        self, index: int, engine: InferenceEngine,
+        replica_class: str = "mixed",
+    ):
         self.index = index
         self.engine = engine
+        self.replica_class = replica_class
+        self.completions_seen = 0
         self.state = "up"
         self.consecutive_failures = 0
         self.no_progress_ticks = 0
@@ -126,6 +217,7 @@ class ReplicaRouter:
         replicas: int = 2,
         engines: Optional[Sequence[InferenceEngine]] = None,
         engine_kwargs: Optional[Dict[str, Any]] = None,
+        replica_classes: Optional[Sequence[str]] = None,
         max_queue: Optional[int] = None,
         replica_queue_depth: int = 2,
         faults: Optional[FaultPlan] = None,
@@ -175,9 +267,62 @@ class ReplicaRouter:
                     f"(prefill_token_budget) so migrated requests can "
                     f"recompute their carried tokens"
                 )
+        if replica_classes is None:
+            replica_classes = ["mixed"] * len(engines)
+        replica_classes = [str(c) for c in replica_classes]
+        if len(replica_classes) != len(engines):
+            raise ValueError(
+                f"replica_classes has {len(replica_classes)} entries "
+                f"for {len(engines)} replicas"
+            )
+        for c in replica_classes:
+            if c not in REPLICA_CLASSES:
+                raise ValueError(
+                    f"unknown replica class {c!r}; classes are "
+                    f"{REPLICA_CLASSES}"
+                )
+        if "prefill" in replica_classes and (
+            "decode" not in replica_classes
+        ):
+            raise ValueError(
+                "a prefill-class replica needs at least one "
+                "decode-class replica to hand finished prompts to"
+            )
+        self._has_classes = any(
+            c != "mixed" for c in replica_classes
+        )
+        if self._has_classes:
+            for i, eng in enumerate(engines):
+                if not eng.paged:
+                    raise ValueError(
+                        f"replica {i}: prefill/decode classes need "
+                        f"paged engines — the handoff ships KV pages"
+                    )
         self._replicas = [
-            _Replica(i, eng) for i, eng in enumerate(engines)
+            _Replica(i, eng, replica_classes[i])
+            for i, eng in enumerate(engines)
         ]
+        # cross-replica shared prefix registry: subscribe to every
+        # compatible PrefixStore's register/unregister hooks so
+        # placement sees the whole fleet's materialized chains
+        self._prefix_registry: Optional[SharedPrefixRegistry] = None
+        stores = [
+            (rep.index, rep.engine._store) for rep in self._replicas
+            if getattr(rep.engine, "_store", None) is not None
+        ]
+        if stores:
+            page_size = stores[0][1].page_size
+            registry_ = SharedPrefixRegistry(page_size)
+            for idx, store in stores:
+                if store.page_size != page_size:
+                    continue  # incompatible chain geometry: skip
+                store.on_register = (
+                    lambda key, page, i=idx: registry_.publish(i, key)
+                )
+                store.on_unregister = (
+                    lambda key, page, i=idx: registry_.unpublish(i, key)
+                )
+            self._prefix_registry = registry_
         self.capacity = min(eng.capacity for eng in engines)
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -225,6 +370,8 @@ class ReplicaRouter:
         self._rejoins = 0
         self._affinity_hits = 0
         self._kills = 0
+        self._handoffs = 0
+        self._page_migrations = 0
         self._finished: Dict[str, int] = {}
         #: every replica-scoped fault that fired, as (site, tick,
         #: replica) — the `FaultPlan.reset()` replay witness
@@ -236,8 +383,9 @@ class ReplicaRouter:
         self.registry = registry
         self._c_events = registry.counter(
             "router_events_total",
-            "Fleet lifecycle events (migration, quarantine, rejoin, "
-            "affinity_hit, kill, shed, drain_replica).",
+            "Fleet lifecycle events (migration, page_migration, "
+            "handoff, quarantine, rejoin, affinity_hit, kill, shed, "
+            "drain_replica).",
             labelnames=("event",),
         )
         self._g_healthy = registry.gauge(
@@ -245,6 +393,23 @@ class ReplicaRouter:
         )
         self._g_pending = registry.gauge(
             "router_queue_depth", "Requests in the global queue."
+        )
+        # per-class latency attribution (PR-14 labeled families): a
+        # request observes under the class of the replica it FINISHED
+        # on — in a disaggregated fleet that is the decode class for
+        # every handed-off request, which is exactly the class whose
+        # TTFT/TPOT SLO the disaggregation is supposed to protect
+        self._h_class_ttft = registry.histogram(
+            "router_ttft_ms",
+            "Time to first token (enqueue -> first token), ms, by the "
+            "finishing replica's class.",
+            labelnames=("replica_class",),
+        )
+        self._h_class_tpot = registry.histogram(
+            "router_tpot_ms",
+            "Mean inter-token time after the first token, ms, by the "
+            "finishing replica's class.",
+            labelnames=("replica_class",),
         )
         self._g_healthy.set(len(self._replicas))
 
@@ -398,6 +563,9 @@ class ReplicaRouter:
             for r in results:
                 self._deliver(r, out)
             self._refresh_mirror(rep)
+            self._record_class_latency(rep)
+        if self._has_classes:
+            self._handoff_prefill()
         self._probe_health()
         self._probe_progress()
         self._probe_rejoin()
@@ -476,7 +644,7 @@ class ReplicaRouter:
         rep = self._replicas[i]
         if rep.state == "drained":
             return
-        recs = rep.engine.evacuate()
+        recs = rep.engine.evacuate(ship_pages=rep.engine.paged)
         self._requeue(recs)
         rep.engine.drain()  # idempotent; closes the engine's admission
         rep.state = "drained"
@@ -530,7 +698,13 @@ class ReplicaRouter:
             "replica_rejoins": float(self._rejoins),
             "affinity_hits": float(self._affinity_hits),
             "replica_kills": float(self._kills),
+            "handoffs": float(self._handoffs),
+            "page_migrations": float(self._page_migrations),
         }
+        if self._prefix_registry is not None:
+            out["shared_prefix_chains"] = float(
+                len(self._prefix_registry)
+            )
         for reason, n in sorted(self._finished.items()):
             out[f"finished_{reason}"] = float(n)
         return out
@@ -574,6 +748,7 @@ class ReplicaRouter:
             "replica_detail": [
                 {
                     "replica": rep.index,
+                    "class": rep.replica_class,
                     "state": rep.state,
                     "consecutive_failures": rep.consecutive_failures,
                     "no_progress_ticks": rep.no_progress_ticks,
@@ -684,6 +859,7 @@ class ReplicaRouter:
                 queue_deadline=rec["queue_deadline"],
                 first_token_at=rec["first_token_at"],
                 chunks=rec["chunks"],
+                pages=rec.pop("pages", None),
             )
             self._assigned[rid] = rep.index
             self._mirror[rid] = rec
@@ -696,16 +872,41 @@ class ReplicaRouter:
     def _place(
         self, rec: Dict[str, Any], candidates: List[_Replica]
     ) -> _Replica:
+        # replica classes: fresh prompts prefer the prefill class,
+        # carried requests (recoveries, handoffs) the decode class;
+        # the mixed class backstops either, and when no preferred
+        # replica has room ANY candidate beats queueing — class purity
+        # never costs availability
+        if self._has_classes:
+            preferred = "decode" if rec["generated"] else "prefill"
+            classed = [
+                rep for rep in candidates
+                if rep.replica_class == preferred
+            ] or [
+                rep for rep in candidates
+                if rep.replica_class == "mixed"
+            ]
+            if classed:
+                candidates = classed
         # prefix affinity: the replica already holding the longest
         # materialized prefix of this prompt skips that much prefill
         # (recovered requests carry tokens and re-prefill anyway, so
         # affinity only scores fresh prompts)
         if not rec["generated"]:
             best, best_tokens = None, 0
-            for rep in candidates:
-                n = rep.engine.prefix_match_tokens(rec["prompt"])
-                if n > best_tokens:
-                    best, best_tokens = rep, n
+            if self._prefix_registry is not None:
+                # one chain walk against the fleet-wide registry
+                # instead of N per-engine store consults
+                matched = self._prefix_registry.best(rec["prompt"])
+                for rep in candidates:
+                    n = matched.get(rep.index, 0)
+                    if n > best_tokens:
+                        best, best_tokens = rep, n
+            else:
+                for rep in candidates:
+                    n = rep.engine.prefix_match_tokens(rec["prompt"])
+                    if n > best_tokens:
+                        best, best_tokens = rep, n
             if best is not None:
                 self._affinity_hits += 1
                 self._count_event("affinity_hit")
@@ -758,6 +959,69 @@ class ReplicaRouter:
                 mine["first_token_at"] = rec["first_token_at"]
                 mine["chunks"] = rec["chunks"]
 
+    def _record_class_latency(self, rep: _Replica) -> None:
+        """Fold the replica's NEW completion records into the
+        class-labeled TTFT/TPOT families — the per-class attribution
+        the disaggregated fleet is judged by."""
+        if not self.registry.enabled:
+            return
+        records = rep.engine.completions
+        if len(records) < rep.completions_seen:
+            rep.completions_seen = 0  # engine reset_stats
+        fresh = records[rep.completions_seen:]
+        rep.completions_seen = len(records)
+        for c in fresh:
+            if c.get("new_tokens", 0) <= 0:
+                continue  # shed/cancelled before any token: no latency
+            self._h_class_ttft.observe(
+                c["ttft_ms"], replica_class=rep.replica_class
+            )
+            self._h_class_tpot.observe(
+                c["tpot_ms"], replica_class=rep.replica_class
+            )
+
+    def _handoff_prefill(self) -> None:
+        """The disaggregation transfer: a prefill-class replica keeps
+        a request only until its prompt is materialized (>= 1 token
+        emitted); it is then evacuated WITH its KV pages and requeued
+        — `_place` lands carried requests on the decode class, where
+        the payload imports and decode continues without re-prefill.
+        Skipped entirely while no decode-class replica has room: the
+        request keeps decoding where it is (availability over class
+        purity), and a dropped/failed page import degrades to token
+        replay — token-identical either way."""
+        decode_ready = any(
+            rep.in_rotation and rep.replica_class == "decode"
+            and rep.stall_ticks == 0
+            and (
+                rep.engine.num_active < rep.engine.num_slots
+                or rep.engine.num_queued < self.replica_queue_depth
+            )
+            for rep in self._replicas
+        )
+        if not decode_ready:
+            return
+        for rep in self._replicas:
+            if not rep.in_rotation or rep.replica_class != "prefill":
+                continue
+            for rec0 in rep.engine.outstanding():
+                if not rec0["generated"]:
+                    continue
+                rec = rep.engine.evacuate_request(
+                    rec0["request_id"], ship_pages=True
+                )
+                if rec is None:
+                    continue
+                self._handoffs += 1
+                self._count_event("handoff")
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "handoff", track=f"req{rec['request_id']}",
+                        replica=rep.index,
+                        shipped="pages" in rec,
+                    )
+                self._requeue([rec])
+
     def _requeue(self, recs: List[Dict[str, Any]]) -> None:
         """Resubmit migration records at the HEAD of the global queue
         (preserving their order ahead of fresh arrivals)."""
@@ -768,17 +1032,23 @@ class ReplicaRouter:
             self._pending.appendleft(rec)
             self._migrations += 1
             self._count_event("migration")
+            if "pages" in rec:
+                self._page_migrations += 1
+                self._count_event("page_migration")
             if self.tracer.enabled:
                 self.tracer.instant(
                     "migrate", track=f"req{rid}",
                     carried=len(rec["generated"]),
+                    shipped="pages" in rec,
                 )
 
     def _quarantine_replica(self, rep: _Replica, why: str) -> None:
         """Failure path for a replica whose ENGINE is still intact
         (step failures, watchdog, zero progress): evacuate its exact
-        request inventory and put it back on the global queue."""
-        recs = rep.engine.evacuate()
+        request inventory — WITH its KV pages on a paged cache, so the
+        destination can resume by page import instead of re-prefill —
+        and put it back on the global queue."""
+        recs = rep.engine.evacuate(ship_pages=rep.engine.paged)
         self._requeue(recs)
         rep.state = "quarantined"
         rep.quarantined_at = self._tick

@@ -375,11 +375,18 @@ class ParallelAttention(nn.Module):
         if sp:
             # x is the local sequence shard; the QKV projection's
             # internal all-gather restores the full sequence, which is
-            # what every attention path below operates on
-            if cache is not None:
+            # what every attention path below operates on. The PACKED
+            # chunk path composes: the chunk stream is a flat token
+            # axis (slot/position indirection rides in `chunk`, not in
+            # the sequence dim), so scattering it across ranks and
+            # all-gathering inside the projection reconstructs exactly
+            # the full chunk. Plain cached decode does not (its seq
+            # axis is width-1 per slot and cannot be seq-sharded).
+            if cache is not None and chunk is None:
                 raise ValueError(
-                    "sequence_parallel does not compose with KV-cached "
-                    "inference (the cache holds full sequences)"
+                    "sequence_parallel composes with KV-cached inference "
+                    "only on the packed chunk path (the decode step's "
+                    "width-1 sequence axis cannot be sequence-sharded)"
                 )
             sq = sq * tp
 
@@ -521,7 +528,10 @@ class ParallelAttention(nn.Module):
             spec = len(chunk) == 3
             chunk_slots, chunk_pos = chunk[0], chunk[1]
             commit_slots = chunk[2] if spec else chunk_slots
-            budget = x.shape[1]
+            # full packed width: under sequence parallelism x carries
+            # only the local shard, but qkv was all-gathered back to
+            # the full chunk — sq already accounts for that
+            budget = sq
             q, k, v = jnp.split(qkv, 3, axis=-1)  # (1, budget, nh, hd)
             qq, kq, vq = q[0], k[0], v[0]  # (budget, nh, hd)
             k_sc = v_sc = None
@@ -1470,10 +1480,12 @@ class GPTModel(nn.Module):
                     "KV-cached inference returns logits; pass labels "
                     "only on the training path"
                 )
-            if self.cfg.sequence_parallel:
+            if self.cfg.sequence_parallel and chunk is None:
                 raise ValueError(
-                    "sequence_parallel does not compose with KV-cached "
-                    "inference (the cache holds full sequences)"
+                    "sequence_parallel composes with KV-cached inference "
+                    "only on the packed chunk path (pass chunk=, or use "
+                    "a model config with sequence_parallel=False for "
+                    "decode/prefill applies)"
                 )
             if position_ids is None:
                 if chunk is not None:
@@ -1491,13 +1503,27 @@ class GPTModel(nn.Module):
             out = self.transformer(
                 x, deterministic=deterministic, cache=cache, chunk=chunk
             )
+            sp_exit = _sp_active(self.cfg, _resolve_tp(self.cfg))
             if chunk is not None and len(chunk) == 3:
                 # speculative chunk: also return the per-layer packed
                 # chunk K/V (tuple of k, tuple of v) for the host-side
                 # accepted-prefix commit
                 x, cache, chunk_kv = out
+                if sp_exit:
+                    x = gather_from_sequence_parallel_region(
+                        x, self.cfg.tensor_axis, dim=1,
+                        tensor_parallel_output_grad=False,
+                    )
                 return self.embedding.attend(x), cache, chunk_kv
             x, cache = out
+            if sp_exit:
+                # sequence-parallel chunk exit: the residual stream is
+                # seq-sharded (1, budget/tp, h); the vocab head needs
+                # full rows (vocab sharded over the SAME tensor axis)
+                x = gather_from_sequence_parallel_region(
+                    x, self.cfg.tensor_axis, dim=1,
+                    tensor_parallel_output_grad=False,
+                )
             return self.embedding.attend(x), cache
         x = self.embedding(tokens, position_ids, deterministic)
         x = self.transformer(x, deterministic=deterministic)

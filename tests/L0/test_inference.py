@@ -440,7 +440,9 @@ class TestEngine:
             weng.add_request(list(range(9)), 4)
         with pytest.raises(ValueError, match="prefill_token_budget"):
             greedy_engine(model, params, prefill_token_budget=0)
-        with pytest.raises(NotImplementedError, match="tp"):
+        # tp>1 construction demands the parallel_state mesh (and the
+        # paged/chunked serving mode) up front
+        with pytest.raises(ValueError, match="tp>1"):
             InferenceEngine(
                 GPTModel(fp32_cfg(tensor_parallel_size=2)), params
             )

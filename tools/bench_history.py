@@ -16,10 +16,12 @@ read-back:
   back to ``parsed`` when the tail carries none;
 * prints a metric x round trajectory table (newest last) with the
   round-over-round delta for the newest value;
-* exits nonzero when a GUARDED metric (default: the two headline
-  per-chip throughputs, ``gpt_train_tokens_per_sec_per_chip`` and
-  ``gpt_serve_tokens_per_sec_per_chip``) drops more than
-  ``--threshold`` (default 10%) between its two most recent
+* exits nonzero when a GUARDED metric (default: the headline per-chip
+  throughputs — ``gpt_train_tokens_per_sec_per_chip``,
+  ``gpt_serve_tokens_per_sec_per_chip`` and the equal-chip-count
+  serving A/Bs ``gpt_serve_tokens_per_sec_per_chip_tp2`` /
+  ``..._disagg`` from ``bench.py serve --tp=2`` / ``--disagg``) drops
+  more than ``--threshold`` (default 10%) between its two most recent
   appearances. Rounds that didn't run a guarded bench don't trip the
   gate (the diff pairs the last two rounds that DID); ``--warn-only``
   downgrades the failure to a warning for exploratory rounds.
@@ -39,6 +41,8 @@ import sys
 DEFAULT_GUARDS = (
     "gpt_train_tokens_per_sec_per_chip",
     "gpt_serve_tokens_per_sec_per_chip",
+    "gpt_serve_tokens_per_sec_per_chip_tp2",
+    "gpt_serve_tokens_per_sec_per_chip_disagg",
 )
 
 

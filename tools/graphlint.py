@@ -7,7 +7,7 @@ blocking gathers (PR 3), a found_inf skip branch that pays no comm
 (PR 9/11), a fused head that never materializes logits (PR 2), packed
 optimizer programs that stay O(dtype-groups) (PR 9), donated step
 carries — is a property of a TRACED PROGRAM, not of any one test's
-wall clock. This tool re-traces five representative configs
+wall clock. This tool re-traces six representative configs
 abstractly (`jax.make_jaxpr` / AOT `.trace`: zero compiles), runs the
 `monitor/lint.py` rule sets against them, and compares a structural
 fingerprint (collective counts, wire-byte estimates, equation/dot
@@ -31,6 +31,10 @@ nothing here compiles and the suite's compile cache stays warm):
 * ``serve_mixed`` — the serving engine's fused prefill+decode mixed
   step lowered with donated cache buffers: KV-cache donation verified
   from the executable's own ``args_info``, no whole-batch logits.
+* ``serve_mixed_tp2`` — the same mixed step under shard_map at tp=2
+  (sequence-parallel chunk + collective-matmul rings, head-sharded
+  paged pools): exactly 8 ppermute ring hops, no full-seq full-width
+  FFN activation, cache still donated.
 * ``spcm_tp2`` — the tp=2 sequence-parallel + collective-matmul
   transformer stack (init+fwd+bwd): exactly 16 ppermute ring hops, no
   all_gather/reduce_scatter, no full (b, s, h) gathered activation.
@@ -245,6 +249,72 @@ def _build_serve_mixed():
     return subject, rules
 
 
+def _build_serve_mixed_tp2():
+    """The tp=2 fused mixed step under shard_map (PR-17 disaggregated
+    serving rung 1): sequence-parallel chunk with collective-matmul
+    rings, head-sharded paged pools, replicated host control arrays,
+    and the vocab gather before sampling. Ring hops are pinned
+    exactly; all_gather is NOT forbidden here — the sp-exit gather
+    before attend and the vocab-parallel logits gather are the two
+    legitimate blocking collectives of the serving forward."""
+    from rocm_apex_tpu.inference import (
+        InferenceEngine, SamplingParams, shard_tp1_params,
+    )
+    from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
+    from rocm_apex_tpu.transformer import parallel_state
+
+    mesh = parallel_state.initialize_model_parallel(
+        2, 1, devices=jax.devices()[:2]
+    )
+    kw = dict(
+        vocab_size=96, hidden_size=32, num_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        params_dtype=jnp.float32, dtype=jnp.float32,
+        attention_impl="jnp",
+    )
+    toks = jnp.zeros((1, 8), jnp.int32)
+    model1 = GPTModel(GPTConfig(tensor_parallel_size=1, **kw))
+    params1 = model1.init(jax.random.PRNGKey(1), toks)
+    model = GPTModel(GPTConfig(tensor_parallel_size=2, **kw))
+    params = shard_tp1_params(model, params1, mesh)
+    eng = InferenceEngine(
+        model, params, num_slots=2, capacity=24,
+        paged=True, page_size=4,
+        sampling=SamplingParams(temperature=0.0),
+        prefill_token_budget=16, donate_buffers=True,
+    )
+    budget, ns = eng.prefill_token_budget, eng.num_slots
+    i32 = lambda shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+    subject = LintSubject.from_jit(
+        "serve_mixed_tp2", eng._mixed_jit,
+        eng.params, eng.cache,
+        i32((budget,)), i32((budget,)), i32((budget,)),   # tokens/slots/pos
+        i32((ns,)), i32((ns,)),                           # lengths before/after
+        -jnp.ones((ns,), jnp.int32),                      # completion_idx
+        i32((ns,)), jnp.zeros((ns,), bool),               # dec tokens/active
+        jnp.zeros((budget,), jnp.float32),                # chunk poison
+        jnp.zeros((ns,), jnp.float32),                    # dec poison
+        jax.random.PRNGKey(0),
+    )
+    rules = [
+        PrecisionPolicy(compute_dtype="float32"),
+        # the sp+cm chunk rides ppermute rings: 4 TP-edge matmuls
+        # (qkv, attn out, fc, proj) x 2 layers x (tp-1)=1 hop = 8
+        CollectiveContract(expect={"ppermute": 8}),
+        # the full-seq, full-width FFN activation must never exist:
+        # under sp+cm it lives either seq-sharded (1, budget/2, 4h) or
+        # width-sharded (1, budget, 4h/2), never (1, budget, 4h)
+        NoMaterialization(
+            forbidden_shapes=((1, budget, 4 * 32),)
+        ),
+        # the head-sharded paged cache (arg 1) is donated in place
+        DonationContract(min_bytes=float("inf"), require=("args[0][1]",)),
+        TraceStability(),
+    ]
+    return subject, rules
+
+
 def _build_spcm_tp2():
     """tests/L0/test_monitor.py's SP/CM tp=2 stack (init+fwd+bwd):
     the PR-3 ring contract as a standing CI gate."""
@@ -333,6 +403,7 @@ REGISTRY = {
     "gpt_train_bf16": _build_gpt_train_bf16,
     "packed_opt": _build_packed_opt,
     "serve_mixed": _build_serve_mixed,
+    "serve_mixed_tp2": _build_serve_mixed_tp2,
     "spcm_tp2": _build_spcm_tp2,
     "zero_int8": _build_zero_int8,
 }
