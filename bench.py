@@ -347,7 +347,8 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
                 shared_prefix: bool = False, spec_k: int = -1,
                 chaos: int = -1, slo: bool = False,
                 metrics_port: int = -1, replicas: int = 0,
-                tp: int = 0, disagg: bool = False):
+                tp: int = 0, disagg: bool = False,
+                adapters: int = 0, ranks: str = ""):
     """Serving benchmark: the continuous-batching engine on a MIXED
     prompt-length workload (fixed seed — the raggedness is the point:
     whole-prompt prefill pads every prompt to the longest and stalls
@@ -463,6 +464,28 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
     fleet p95 / class p95), attributed to the replica class that
     FINISHED each request — the decode-class line is the
     time-to-first-token the fleet's decode capacity actually delivers.
+
+    ``--adapters=N`` A/Bs batched multi-LoRA serving against the
+    single-model engine on the same workload IN ONE INVOCATION: N
+    tenant adapters (``--ranks=R1,R2,...`` cycles per-adapter ranks,
+    default 2,4,8, rank-padded into one packed `AdapterPool`) are
+    striped across the requests next to base traffic, applied as
+    segmented gather->bmm deltas inside the ONE fused mixed trace.
+    Adapter-0 greedy tokens are asserted bitwise identical to the
+    base engine, at least one adapter must visibly change tokens, and
+    a park/reclaim churn wave (2N registered adapters over N+1
+    residency slots) must neither retrace nor leak refs. Reports
+    ``gpt_serve_adapter_tokens_per_sec_per_chip`` (vs_baseline =
+    aggregate rate / single-model rate — the ~10% adapter tax
+    ceiling). Composed with ``--chaos=SEED`` it runs the
+    tenant-isolation scenario instead: a seeded one-tenant burst
+    (burster and size derived from SEED) replayed through a real
+    `monitor.TenantSLOBoard` on an event-index clock must trip ONLY
+    the bursting tenant's TTFT burn-rate monitor — every other
+    tenant's monitor stays quiet (structural isolation: each reads
+    only its own labeled series) — while the per-tenant
+    completion-accounting identity holds exactly. Reports
+    ``gpt_serve_tenant_isolation``.
 
     ``--spec-k=K`` A/Bs speculative decoding (n-gram self-drafting
     through the mixed step, `inference/drafting.py`) against the
@@ -751,6 +774,300 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
             )
         finally:
             srv.close()
+
+    if adapters > 0:
+        # ---- batched multi-LoRA serving A/B: N tenant adapters ride
+        # the ONE fused mixed chunk+decode program as segmented
+        # gather->bmm deltas over rank-padded packed pool buffers
+        # (ops/lora.py, inference/adapters.py). The headline is
+        # aggregate tok/s staying within ~10% of the single-model
+        # engine on the SAME workload — the adapters must be near-free
+        # — with adapter-0 greedy tokens asserted bitwise identical to
+        # the base engine. Composed with --chaos=SEED it instead runs
+        # the tenant-isolation scenario: a seeded one-tenant burst
+        # must burn ONLY that tenant's TTFT SLO (every other tenant's
+        # monitor on the `TenantSLOBoard` stays quiet) while the
+        # per-tenant completion-accounting identity holds.
+        from rocm_apex_tpu.inference import AdapterPool
+
+        rank_list = [int(r) for r in ranks.split(",") if r] or [2, 4, 8]
+        if any(r < 1 for r in rank_list):
+            raise SystemExit(f"--ranks must be >= 1, got {rank_list}")
+        max_rank = max(rank_list)
+        # widen the A/B window past the serve default (12 req x 6 tok
+        # is ~0.1 s on this box — the ratio drowns in scheduler
+        # jitter); both sides run the SAME widened workload
+        n_req_a = max(n_requests, 4 * (adapters + 1))
+        max_new_a = max(max_new, 24)
+        prompts_a = [
+            prompts[i % len(prompts)] for i in range(n_req_a)
+        ]
+
+        def make_pool(max_resident):
+            return AdapterPool(
+                cfg.num_layers, cfg.hidden_size,
+                max_resident=max_resident, max_rank=max_rank,
+            )
+
+        def register_all(pool, n, seed0=100, prefix="tenant"):
+            # scale 0.5: big enough that a non-base adapter visibly
+            # flips greedy argmax (the delta-took-effect canary)
+            rng_a = np.random.RandomState(seed0)
+            aids = []
+            for i in range(n):
+                r = rank_list[i % len(rank_list)]
+                ws = [
+                    {
+                        "qkv": (
+                            0.5 * rng_a.randn(cfg.hidden_size, r),
+                            0.5 * rng_a.randn(r, 3 * cfg.hidden_size),
+                        ),
+                        "dense": (
+                            0.5 * rng_a.randn(cfg.hidden_size, r),
+                            0.5 * rng_a.randn(r, cfg.hidden_size),
+                        ),
+                    }
+                    for _ in range(cfg.num_layers)
+                ]
+                aids.append(pool.register(
+                    f"{prefix}-{i}", ws, rank=r, tier=i % 3,
+                ))
+            return aids
+
+        def build_lora(pool):
+            return InferenceEngine(
+                model, params, num_slots=num_slots, capacity=capacity,
+                max_prompt_len=max(lens),
+                sampling=SamplingParams(temperature=0.0), seed=0,
+                prefill_token_budget=budget, adapter_pool=pool,
+            )
+
+        def submit_and_drain(eng, work, new_tokens, sink=None):
+            ids = [
+                eng.add_request(p, new_tokens, adapter_id=a)
+                for p, a in work
+            ]
+            out = {}
+            while eng.has_work():
+                for r in eng.step():
+                    out[r.request_id] = r
+            if sink is not None:
+                sink.update(out)
+            return [out[i] for i in ids]
+
+        if chaos >= 0:
+            from rocm_apex_tpu.monitor import (
+                BurnRule, MetricRegistry, TenantSLOBoard,
+            )
+
+            rng_c = np.random.RandomState(chaos)
+            pool = make_pool(adapters + 1)
+            aids = register_all(pool, adapters)
+            burst_aid = aids[int(rng_c.randint(0, len(aids)))]
+            burst_n = 4 * num_slots + int(rng_c.randint(0, num_slots))
+            burst_tenant = pool.tenant_of(burst_aid)
+            eng = build_lora(pool)
+            # warmup compiles the lora mixed + decode programs OUTSIDE
+            # the measured window (a compile spike inside phase 1
+            # would inflate the calibration p95 past any burst)
+            submit_and_drain(
+                eng,
+                list(zip(prompts_a[:num_slots],
+                         ([0] + aids)[:num_slots])),
+                3,
+            )
+            eng.reset_stats()
+            # phase 1 (calm): every tenant — including the future
+            # burster — trickles requests one slot-wave at a time, so
+            # queue wait never builds and the TTFTs calibrate the
+            # alert threshold
+            wave = [
+                (prompts_a[i % len(prompts_a)],
+                 ([0] + aids)[i % (adapters + 1)])
+                for i in range(2 * (adapters + 1))
+            ]
+            for w0 in range(0, len(wave), num_slots):
+                submit_and_drain(eng, wave[w0:w0 + num_slots], max_new)
+            calm = [
+                c["ttft_ms"] for c in eng.completions
+                if c["ttft_ms"] > 0
+            ]
+            threshold = max(2.0 * float(np.percentile(calm, 95)), 1.0)
+            # phase 2 (burst): the seeded tenant dumps burst_n
+            # requests at once — the tail queues behind its own
+            # burst, so ITS ttft blows through 2x the calm p95 while
+            # no other tenant observes a single slow request
+            submit_and_drain(
+                eng,
+                [(prompts_a[j % len(prompts_a)], burst_aid)
+                 for j in range(burst_n)],
+                max_new,
+            )
+            assert eng.mixed_trace_count == 1, (
+                f"adapter burst retraced the mixed step "
+                f"{eng.mixed_trace_count}x"
+            )
+            pool.assert_consistent()
+            assert pool.snapshot()["refs"] == 1, (
+                "adapter refs leaked across the burst"
+            )
+            # per-tenant completion-accounting identity: the host
+            # tenant counters sum EXACTLY to the completion records,
+            # per tenant and in aggregate
+            ts = eng.tenant_stats()
+            by_tenant = {}
+            for c in eng.completions:
+                t = c.get("tenant") or "base"
+                by_tenant[t] = by_tenant.get(t, 0) + 1
+            assert {
+                t: s["completed"] for t, s in ts.items()
+            } == by_tenant, (ts, by_tenant)
+            assert sum(
+                s["generated_tokens"] for s in ts.values()
+            ) == sum(c["new_tokens"] for c in eng.completions)
+            # replay the measured TTFTs through a real TenantSLOBoard
+            # on an event-index clock: one labeled histogram, one
+            # monitor per tenant, each reading ONLY its own series
+            reg_b = MetricRegistry()
+            hist = reg_b.histogram(
+                "serve_ttft_ms",
+                "Replayed per-tenant enqueue->first-token (ms).",
+                labelnames=("tenant",),
+            )
+            board = TenantSLOBoard(
+                hist, objective=0.9, threshold_ms=threshold,
+                windows=(BurnRule(6.0, 3.0, 2.0),),
+            )
+            for t in sorted(by_tenant):
+                board.ensure(t)
+            board.tick(now=0.0)
+            i = 0
+            for c in eng.completions:
+                if c["ttft_ms"] <= 0:
+                    continue
+                i += 1
+                hist.observe(
+                    c["ttft_ms"], tenant=c.get("tenant") or "base"
+                )
+                board.tick(now=float(i))
+                board.alerts(now=float(i))
+            fired = {
+                t for t, mon in board.monitors.items() if mon.events
+            }
+            assert burst_tenant in fired, (
+                f"{burst_tenant}'s burst did not trip its TTFT "
+                f"burn-rate alert (threshold {threshold:.1f} ms)"
+            )
+            assert fired == {burst_tenant}, (
+                f"the burst bled into other tenants' SLOs: "
+                f"{sorted(fired - {burst_tenant})} also fired"
+            )
+            n_alerts = len(board.monitors[burst_tenant].events)
+            print(
+                f"serve[adapters={adapters} chaos seed={chaos}]: "
+                f"tenant {burst_tenant} burst {burst_n} requests, "
+                f"{n_alerts} alert(s) at threshold {threshold:.1f} ms; "
+                f"{len(by_tenant) - 1} other tenants quiet; "
+                f"accounting identity holds "
+                f"({len(eng.completions)} records)",
+                file=sys.stderr,
+            )
+            _report(
+                "gpt_serve_tenant_isolation", float(n_alerts),
+                "alerts", 1.0,
+                f"seeded one-tenant burst (seed={chaos}): only "
+                f"{burst_tenant}'s burn-rate monitor fired; "
+                f"per-tenant completion accounting exact; mixed step "
+                f"traced once; adapter pool leak-free",
+            )
+            if metrics_port >= 0:
+                scrape_metrics(eng)
+            return
+
+        # ---- throughput A/B: single-model reference first, then the
+        # same workload with requests striped across base + N adapters
+        def run_base():
+            eng = build(True)
+            eng.generate(prompts_a[:num_slots], max_new_tokens=3)
+            eng.reset_stats()
+            t0 = time.perf_counter()
+            results = eng.generate(prompts_a, max_new_tokens=max_new_a)
+            dt = time.perf_counter() - t0
+            gen = sum(len(r.tokens) for r in results)
+            return eng, results, gen / dt, dt
+
+        eng_b, res_b, rate_b, dt_b = run_base()
+        pool = make_pool(adapters + 1)  # all resident: pure serving
+        aids = register_all(pool, adapters)
+        assign = [
+            ([0] + aids)[i % (adapters + 1)] for i in range(n_req_a)
+        ]
+        eng_a = build_lora(pool)
+        submit_and_drain(
+            eng_a,
+            list(zip(prompts_a[:num_slots], assign[:num_slots])), 3,
+        )
+        eng_a.reset_stats()
+        t0 = time.perf_counter()
+        res_a = submit_and_drain(
+            eng_a, list(zip(prompts_a, assign)), max_new_a
+        )
+        dt_a = time.perf_counter() - t0
+        rate_a = sum(len(r.tokens) for r in res_a) / dt_a
+        assert eng_a.mixed_trace_count == 1, (
+            f"{adapters} adapters traced the mixed step "
+            f"{eng_a.mixed_trace_count}x — the segmented delta must "
+            f"live inside the ONE program"
+        )
+        # adapter-0 requests are the base model: bitwise identical
+        base_reqs = [i for i, a in enumerate(assign) if a == 0]
+        for i in base_reqs:
+            assert res_a[i].tokens == res_b[i].tokens, (
+                f"adapter-0 request {i} diverged from the base engine"
+            )
+        assert any(
+            res_a[i].tokens != res_b[i].tokens
+            for i, a in enumerate(assign) if a != 0
+        ), "no adapter changed any tokens — deltas not applied?"
+        # park/reclaim churn on the SAME engine: register a second
+        # wave of adapters past residency and cycle through them —
+        # evictions/revivals must not retrace or leak
+        extra = register_all(pool, adapters, seed0=200, prefix="late")
+        churn = [aids[-1]] + extra + [aids[0]]
+        for aid in churn:
+            submit_and_drain(eng_a, [(prompts_a[0], aid)], 2)
+        snap = pool.snapshot()
+        assert snap["evictions"] > 0, snap
+        assert eng_a.mixed_trace_count == 1, (
+            "adapter park/reclaim retraced the mixed step"
+        )
+        pool.assert_consistent()
+        assert snap["refs"] == 1, "adapter refs leaked"
+        ratio = rate_a / rate_b
+        s_a = eng_a.stats()
+        print(
+            f"serve[adapters={adapters}]: {rate_a:.1f} gen tok/s over "
+            f"{dt_a:.2f}s vs single-model {rate_b:.1f} "
+            f"({ratio:.2f}x); ranks {rank_list} padded to {max_rank}; "
+            f"uploads={int(s_a['adapter_uploads'])} "
+            f"evictions={int(s_a['adapter_evictions'])} "
+            f"revivals={int(s_a['adapter_revivals'])}; adapter-0 "
+            f"tokens bitwise identical ({len(base_reqs)} reqs); "
+            f"mixed step traced once across {2 * adapters} adapters "
+            f"+ churn",
+            file=sys.stderr,
+        )
+        _report(
+            "gpt_serve_adapter_tokens_per_sec_per_chip", rate_a,
+            "tokens/s", ratio,
+            f"{adapters} concurrent adapters (ranks {rank_list}, "
+            f"rank-padded to {max_rank}) vs single-model "
+            f"{rate_b:.1f} tok/s (ratio = vs_baseline); one mixed "
+            f"trace; adapter-0 bitwise identical to base",
+        )
+        if metrics_port >= 0:
+            scrape_metrics(eng_a)
+        return
 
     if tp >= 2:
         # ---- equal-chip-count tensor-parallel A/B: tp=1 on 1 chip vs
@@ -2517,6 +2834,10 @@ if __name__ == "__main__":
             kwargs["tp"] = int(a.split("=", 1)[1])
         elif a == "--disagg":
             kwargs["disagg"] = True
+        elif a.startswith("--adapters="):
+            kwargs["adapters"] = int(a.split("=", 1)[1])
+        elif a.startswith("--ranks="):
+            kwargs["ranks"] = a.split("=", 1)[1]
         elif a == "--dist-opt":
             kwargs["dist_opt"] = True
         elif a.startswith("--comm-dtype="):
@@ -2561,12 +2882,28 @@ if __name__ == "__main__":
         or "chaos" in kwargs or "slo" in kwargs
         or "metrics_port" in kwargs or "replicas" in kwargs
         or "tp" in kwargs or "disagg" in kwargs
+        or "adapters" in kwargs or "ranks" in kwargs
     ) and which != "serve":
         raise SystemExit(
             "--budget/--whole-prompt/--trace/--paged/--page-size/"
             "--kv-dtype/--shared-prefix/--spec-k/--chaos/--slo/"
-            "--metrics-port/--replicas/--tp/--disagg apply to the "
-            "serve bench"
+            "--metrics-port/--replicas/--tp/--disagg/--adapters/"
+            "--ranks apply to the serve bench"
+        )
+    if kwargs.get("adapters", 1) < 1:
+        raise SystemExit("--adapters takes a pool size N >= 1")
+    if "ranks" in kwargs and "adapters" not in kwargs:
+        raise SystemExit("--ranks requires --adapters")
+    if "adapters" in kwargs and any(
+        k in kwargs
+        for k in ("whole_prompt", "shared_prefix", "spec_k", "paged",
+                  "kv_dtype", "page_size", "replicas", "tp", "disagg",
+                  "slo", "trace")
+    ):
+        raise SystemExit(
+            "--adapters runs its own single-model A/B (or, with "
+            "--chaos, the tenant-isolation scenario); it composes "
+            "with --chaos/--budget/--metrics-port only"
         )
     if kwargs.get("tp", 2) < 2:
         raise SystemExit("--tp takes a tensor-parallel width N >= 2")

@@ -11,6 +11,12 @@ nothing is forked:
                `PageAllocator`, and the copy-on-write `PrefixStore`
                that shares materialized prompt pages across requests;
                optional int8 pools with per-(page, head) scales
+    adapters   multi-LoRA `AdapterPool`: rank-padded packed adapter
+               factors in fixed-shape paged device buffers (the
+               `PageAllocator` idiom — ref-counts, LRU park on idle
+               tenants, reclaim on pressure), host registry keyed by
+               tenant; `ops/lora.py` contracts per-token deltas out of
+               it inside the one mixed serving trace
     sampling   greedy / temperature / top-k / top-p, jit-able and
                seed-deterministic
     drafting   n-gram self-drafter for speculative decoding: proposes
@@ -43,6 +49,10 @@ The model side lives in `models/gpt.py` (``cache=`` on `GPTModel`) and
 the cache layout and the serving loop. See docs/inference.md.
 """
 
+from rocm_apex_tpu.inference.adapters import (  # noqa: F401
+    BASE_ADAPTER_ID,
+    AdapterPool,
+)
 from rocm_apex_tpu.inference.drafting import NGramDrafter  # noqa: F401
 from rocm_apex_tpu.inference.engine import (  # noqa: F401
     FINISH_REASONS,
@@ -78,6 +88,8 @@ from rocm_apex_tpu.inference.sampling import (  # noqa: F401
 )
 
 __all__ = [
+    "AdapterPool",
+    "BASE_ADAPTER_ID",
     "KVCache",
     "PagedKVCache",
     "PageAllocator",

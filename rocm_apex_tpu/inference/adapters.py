@@ -1,0 +1,322 @@
+"""Paged multi-LoRA adapter pool: thousands of registered tenants,
+a fixed-shape device residency window.
+
+`ops/lora.py` made adapter ids DATA — the one mixed trace contracts
+per-token factors gathered from packed ``(L, P, h, r)`` / ``(L, P, r,
+o)`` device buffers, so the program never depends on WHICH adapters
+are resident, only on the pool geometry. That leaves exactly one
+problem: thousands of registered fine-tunes cannot all live in HBM,
+and this module solves it with the machinery the KV cache already
+proved out — buffer slots are pages of a `PageAllocator`:
+
+* ref-counts — one ref per in-flight request using the adapter
+  (admission `acquire`s, every teardown path `release`s once);
+* LRU park — an idle tenant's slot keeps its bytes (`decref(park=
+  True)`), so the next request from that tenant revives it for free
+  (`ref`) with NO re-upload and NO retrace;
+* reclaim on pressure — a fresh tenant's `alloc` evicts the
+  least-recently-parked slot (`on_evict` unmaps it here); when every
+  slot is pinned by in-flight work `acquire` returns None and the
+  engine backpressures at admission — token-level, never a deadlock,
+  because finishing requests always release refs.
+
+Slot 0 is the base model: allocated at construction (the allocator's
+free list is ``deque(range(n))``, so the first ``alloc(1)`` is
+deterministically ``[0]``), zero-filled forever, its ref never
+dropped. ``adapter_id == 0`` therefore means "no adapter" end to end
+— the gather reads zeros and `apply_lora`'s skip branch never fires a
+FLOP on pure-base batches.
+
+Host side, the registry keyed by tenant keeps rank-padded fp32 copies
+(`ops.lora.pad_rank` folds alpha/rank into B at registration — exact,
+since padding rank columns with zeros adds ``x @ 0``), plus the
+admission `tier` each tenant bought. The device buffers themselves
+are a plain pytree the engine donates through its jits and re-binds
+each tick (`buffers` is assignable for exactly that reason).
+"""
+
+import collections
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from rocm_apex_tpu.inference.paging import PageAllocator
+from rocm_apex_tpu.ops.lora import pad_rank
+
+__all__ = ["AdapterPool", "BASE_ADAPTER_ID"]
+
+# adapter_id 0 = base model everywhere: requests default to it,
+# buffer slot 0 holds zeros, acquire/release are free no-ops.
+BASE_ADAPTER_ID = 0
+
+# projection targets carrying deltas, in model order. "qkv" hooks the
+# fused query_key_value projection (h -> 3h), "dense" the attention
+# output projection (h -> h).
+TARGETS = ("qkv", "dense")
+
+
+class AdapterPool:
+    """Fixed-shape paged device buffers + host registry for LoRA
+    adapters (see module docstring for the residency protocol)."""
+
+    def __init__(
+        self,
+        num_layers: int,
+        hidden: int,
+        *,
+        max_resident: int = 8,
+        max_rank: int = 8,
+        qkv_out: Optional[int] = None,
+    ):
+        if num_layers < 1 or hidden < 1:
+            raise ValueError(
+                f"bad pool geometry: layers={num_layers} hidden={hidden}"
+            )
+        if max_resident < 2:
+            # slot 0 is the base; a pool that can hold zero actual
+            # adapters admits nothing and deadlocks admission.
+            raise ValueError(
+                f"max_resident must be >= 2 (slot 0 is the base), "
+                f"got {max_resident}"
+            )
+        if max_rank < 1:
+            raise ValueError(f"max_rank must be >= 1, got {max_rank}")
+        self.num_layers = int(num_layers)
+        self.hidden = int(hidden)
+        self.max_resident = int(max_resident)
+        self.max_rank = int(max_rank)
+        self.out_dims = {
+            "qkv": int(qkv_out) if qkv_out is not None else 3 * hidden,
+            "dense": int(hidden),
+        }
+
+        L, P, h, r = num_layers, max_resident, hidden, max_rank
+        self._buffers: Dict[str, Tuple[Any, Any]] = {
+            t: (
+                jnp.zeros((L, P, h, r), jnp.float32),
+                jnp.zeros((L, P, r, self.out_dims[t]), jnp.float32),
+            )
+            for t in TARGETS
+        }
+
+        self._alloc = PageAllocator(max_resident)
+        self._alloc.on_evict = self._on_evict
+        base = self._alloc.alloc(1)
+        assert base == [0], f"base slot must be 0, allocator gave {base}"
+
+        # host registry: adapter_id -> padded fp32 factors / metadata
+        self._host: Dict[int, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
+        self._tenant: Dict[int, str] = {BASE_ADAPTER_ID: "base"}
+        self._tier: Dict[int, int] = {BASE_ADAPTER_ID: 0}
+        self._rank: Dict[int, int] = {BASE_ADAPTER_ID: 0}
+        self._by_tenant: Dict[str, int] = {}
+        self._slot_of: Dict[int, int] = {BASE_ADAPTER_ID: 0}
+        self._aid_at: Dict[int, int] = {0: BASE_ADAPTER_ID}
+        self._next_id = 1
+        # observability: park/reclaim economics for tests + stats()
+        self.uploads = 0
+        self.evictions = 0
+        self.revivals = 0
+
+    # ------------------------------------------------------------- #
+    # device buffers (the engine donates these through its jits and
+    # re-binds the aliased outputs every tick)
+    # ------------------------------------------------------------- #
+
+    @property
+    def buffers(self) -> Dict[str, Tuple[Any, Any]]:
+        """{"qkv": (A, B), "dense": (A, B)} device pytree; A is
+        (L, P, h, r), B is (L, P, r, out)."""
+        return self._buffers
+
+    @buffers.setter
+    def buffers(self, value: Dict[str, Tuple[Any, Any]]) -> None:
+        if set(value) != set(TARGETS):
+            raise ValueError(f"buffer pytree keys {set(value)}")
+        self._buffers = {t: (value[t][0], value[t][1]) for t in TARGETS}
+
+    # ------------------------------------------------------------- #
+    # registry
+    # ------------------------------------------------------------- #
+
+    def register(
+        self,
+        tenant: str,
+        weights: List[Dict[str, Tuple[Any, Any]]],
+        *,
+        rank: int,
+        alpha: Optional[float] = None,
+        tier: int = 0,
+    ) -> int:
+        """Register a tenant's adapter; returns its adapter_id (>= 1).
+
+        ``weights`` is one dict per layer, each mapping a target in
+        ``TARGETS`` to its ``(A: (h, r), B: (r, out))`` factors; a
+        target missing from a layer's dict contributes no delta there
+        (zeros). Factors are rank-padded and alpha-scaled here, once
+        — registration is the cold path."""
+        if not tenant or tenant == "base":
+            raise ValueError(f"bad tenant name {tenant!r}")
+        if tenant in self._by_tenant:
+            raise ValueError(f"tenant {tenant!r} already registered")
+        if len(weights) != self.num_layers:
+            raise ValueError(
+                f"expected {self.num_layers} per-layer weight dicts, "
+                f"got {len(weights)}"
+            )
+        packed: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for t in TARGETS:
+            o = self.out_dims[t]
+            a_l = np.zeros(
+                (self.num_layers, self.hidden, self.max_rank), np.float32
+            )
+            b_l = np.zeros((self.num_layers, self.max_rank, o), np.float32)
+            for li, layer in enumerate(weights):
+                if t not in layer:
+                    continue
+                a, b = layer[t]
+                if np.asarray(a).shape != (self.hidden, rank):
+                    raise ValueError(
+                        f"layer {li} {t} A shape "
+                        f"{np.asarray(a).shape} != ({self.hidden}, {rank})"
+                    )
+                if np.asarray(b).shape != (rank, o):
+                    raise ValueError(
+                        f"layer {li} {t} B shape "
+                        f"{np.asarray(b).shape} != ({rank}, {o})"
+                    )
+                a_l[li], b_l[li] = pad_rank(a, b, self.max_rank, alpha)
+            packed[t] = (a_l, b_l)
+        aid = self._next_id
+        self._next_id += 1
+        self._host[aid] = packed
+        self._tenant[aid] = tenant
+        self._tier[aid] = int(tier)
+        self._rank[aid] = int(rank)
+        self._by_tenant[tenant] = aid
+        return aid
+
+    def lookup(self, tenant: str) -> Optional[int]:
+        return self._by_tenant.get(tenant)
+
+    def tenant_of(self, adapter_id: int) -> str:
+        return self._tenant[adapter_id]
+
+    def tier_of(self, adapter_id: int) -> int:
+        return self._tier[adapter_id]
+
+    def rank_of(self, adapter_id: int) -> int:
+        return self._rank[adapter_id]
+
+    def known(self, adapter_id: int) -> bool:
+        return adapter_id == BASE_ADAPTER_ID or adapter_id in self._host
+
+    @property
+    def num_registered(self) -> int:
+        """Registered adapters, base excluded."""
+        return len(self._host)
+
+    # ------------------------------------------------------------- #
+    # residency
+    # ------------------------------------------------------------- #
+
+    def resident(self, adapter_id: int) -> bool:
+        return adapter_id in self._slot_of
+
+    def slot_of(self, adapter_id: int) -> Optional[int]:
+        return self._slot_of.get(adapter_id)
+
+    def acquire(self, adapter_id: int) -> Optional[int]:
+        """One admission ref on the adapter; returns its buffer slot,
+        or None when every slot is pinned (token-level backpressure —
+        the caller skips this request and retries next tick). Never
+        raises on pressure, only on unknown ids."""
+        if adapter_id == BASE_ADAPTER_ID:
+            return 0
+        if adapter_id not in self._host:
+            raise KeyError(f"unknown adapter_id {adapter_id}")
+        slot = self._slot_of.get(adapter_id)
+        if slot is not None:
+            if self._alloc.refcount(slot) == 0:
+                self.revivals += 1  # parked -> live, bytes reused
+            self._alloc.ref(slot)
+            return slot
+        got = self._alloc.alloc(1)
+        if got is None:
+            return None
+        slot = got[0]
+        self._upload(adapter_id, slot)
+        self._slot_of[adapter_id] = slot
+        self._aid_at[slot] = adapter_id
+        return slot
+
+    def release(self, adapter_id: int) -> None:
+        """Drop one admission ref. The slot PARKS at refcount zero —
+        bytes stay resident for revival until allocation pressure
+        reclaims the LRU slot."""
+        if adapter_id == BASE_ADAPTER_ID:
+            return
+        slot = self._slot_of.get(adapter_id)
+        if slot is None:
+            raise RuntimeError(
+                f"release of non-resident adapter {adapter_id} "
+                f"(double release?)"
+            )
+        self._alloc.decref(slot, park=True)
+
+    def refs(self, adapter_id: int) -> int:
+        slot = self._slot_of.get(adapter_id)
+        return 0 if slot is None else self._alloc.refcount(slot)
+
+    def _on_evict(self, slot: int) -> None:
+        aid = self._aid_at.pop(slot)
+        del self._slot_of[aid]
+        self.evictions += 1
+
+    def _upload(self, adapter_id: int, slot: int) -> None:
+        packed = self._host[adapter_id]
+        for t in TARGETS:
+            A, B = self._buffers[t]
+            a_h, b_h = packed[t]
+            self._buffers[t] = (
+                A.at[:, slot].set(jnp.asarray(a_h)),
+                B.at[:, slot].set(jnp.asarray(b_h)),
+            )
+        self.uploads += 1
+
+    # ------------------------------------------------------------- #
+    # invariants / observability
+    # ------------------------------------------------------------- #
+
+    def snapshot(self) -> Dict[str, int]:
+        """Counters for leak checks — after every in-flight request
+        has finished, ``refs`` must be exactly 1 (the base slot's
+        permanent self-ref)."""
+        s = self._alloc.snapshot()
+        s.update(
+            resident=len(self._slot_of) - 1,  # base excluded
+            registered=self.num_registered,
+            uploads=self.uploads,
+            evictions=self.evictions,
+            revivals=self.revivals,
+        )
+        return s
+
+    def assert_consistent(self) -> None:
+        """Allocator partition invariants plus the residency-map
+        bijection; run by tests after every teardown path."""
+        self._alloc.assert_consistent()
+        assert self._slot_of.get(BASE_ADAPTER_ID) == 0, "base slot moved"
+        assert self._alloc.refcount(0) >= 1, "base slot ref dropped"
+        for aid, slot in self._slot_of.items():
+            assert self._aid_at.get(slot) == aid, (
+                f"slot map corrupt: adapter {aid} -> slot {slot} -> "
+                f"adapter {self._aid_at.get(slot)}"
+            )
+        for slot, aid in self._aid_at.items():
+            assert self._slot_of.get(aid) == slot, (
+                f"slot map corrupt: slot {slot} -> adapter {aid} -> "
+                f"slot {self._slot_of.get(aid)}"
+            )

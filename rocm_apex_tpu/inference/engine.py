@@ -183,6 +183,11 @@ class Request:
     # still queued past it is expired without ever taking a slot.
     deadline: Optional[float] = None
     queue_deadline: Optional[float] = None
+    # multi-LoRA serving (engines built with adapter_pool=): the
+    # registered adapter this request decodes under (0 = base model)
+    # and the tenant it bills to (None on a base engine)
+    adapter_id: int = 0
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -222,6 +227,9 @@ class _Slot:
     borrowed: Set[int] = dataclasses.field(default_factory=set)
     chain_key: Any = None
     reg_pages: int = 0
+    # adapter-pool buffer slot this lease holds ONE admission ref on
+    # (0 = base, no ref; -1 = already released — the teardown guard)
+    adapter_slot: int = 0
 
     @property
     def prefilling(self) -> bool:
@@ -345,6 +353,8 @@ class InferenceEngine:
         registry=None,
         stats_retention: int = 4096,
         step_source: Optional["InferenceEngine"] = None,
+        adapter_pool=None,
+        tier_preemption: bool = False,
     ):
         cfg = model.cfg
         tp = int(cfg.tensor_parallel_size or 1)
@@ -451,6 +461,56 @@ class InferenceEngine:
         self._spec_window = int(
             getattr(self._drafter, "window", spec_window)
         )
+        # ---- multi-LoRA serving (ISSUE 18) ---------------------------
+        # adapter_pool: an `inference.adapters.AdapterPool` whose
+        # packed device buffers the lora step closures below gather
+        # per-token deltas from (ops/lora.py). The pool is engine-owned
+        # state like the KV cache: its buffers are donated through the
+        # jits and re-bound every tick. Admission acquires one ref per
+        # in-flight request (tier-ordered, acquire-or-skip — see
+        # `_pick_queued`); every teardown path releases exactly once.
+        self.adapter_pool = adapter_pool
+        self.tier_preemption = bool(tier_preemption)
+        self._adapter_stalls = 0
+        self._tier_preemptions = 0
+        self._tier_sheds = 0
+        # host-side per-tenant completion accounting (the chaos
+        # isolation identity: sums across tenants == the global
+        # counters) — keyed by TRUE tenant name, unlike the labeled
+        # metric families which overflow into "other" at the cap
+        self._tenant_counts: Dict[str, Dict[str, int]] = {}
+        if adapter_pool is not None:
+            if tp > 1:
+                raise ValueError(
+                    "adapter_pool serving is tp=1 only for now (the "
+                    "segmented gather would need head-sharded adapter "
+                    "buffers)"
+                )
+            if self.spec_k > 0:
+                raise ValueError(
+                    "adapter_pool does not compose with speculative "
+                    "decoding yet (the drafter is base-model-only; a "
+                    "per-adapter draft would be wrong for every "
+                    "non-base slot)"
+                )
+            if self.prefill_token_budget is None:
+                raise ValueError(
+                    "adapter_pool rides the chunked mixed step; set "
+                    "prefill_token_budget"
+                )
+            if (
+                adapter_pool.num_layers != cfg.num_layers
+                or adapter_pool.hidden != cfg.hidden_size
+                or adapter_pool.out_dims["qkv"] != 3 * cfg.hidden_size
+            ):
+                raise ValueError(
+                    f"adapter pool geometry (layers="
+                    f"{adapter_pool.num_layers}, hidden="
+                    f"{adapter_pool.hidden}, qkv_out="
+                    f"{adapter_pool.out_dims['qkv']}) does not match "
+                    f"the model (layers={cfg.num_layers}, hidden="
+                    f"{cfg.hidden_size})"
+                )
         self.paged = bool(paged)
         self.prefix_sharing = bool(prefix_sharing)
         self._allocator = None
@@ -587,10 +647,44 @@ class InferenceEngine:
             "serve_queue_wait_ms",
             "Request queue wait (enqueue -> slot lease), ms.",
         )
-        self._h_ttft = registry.histogram(
-            "serve_ttft_ms",
-            "Time to first token (enqueue -> first token), ms.",
-        )
+        # multi-tenant engines label TTFT and the token counters by
+        # tenant (the per-tenant SLO feed); unlabeled reads on these
+        # families aggregate across series, so stats() and the base
+        # bench consume both shapes identically. The cardinality cap
+        # is honored by an explicit "other" overflow tenant (see
+        # `_tenant_series`) — the serving hot path NEVER raises
+        # CardinalityError.
+        self._per_tenant = adapter_pool is not None
+        if self._per_tenant:
+            self._h_ttft = registry.histogram(
+                "serve_ttft_ms",
+                "Time to first token (enqueue -> first token), ms.",
+                labelnames=("tenant",),
+            )
+            self._c_tokens = registry.counter(
+                "serve_tokens_total",
+                "Tokens of finished requests, by phase "
+                "(prompt=ingested, generated=emitted) and tenant.",
+                labelnames=("phase", "tenant"),
+            )
+            # pre-create the overflow series so the fallback can never
+            # itself overflow, whatever max_label_sets is
+            self._c_tokens.labels(phase="prompt", tenant="other")
+            self._c_tokens.labels(phase="generated", tenant="other")
+            self._h_ttft.labels(tenant="other")
+            self._tenant_label_ok: Set[str] = {"other"}
+            self._tenant_overflowed: Set[str] = set()
+        else:
+            self._h_ttft = registry.histogram(
+                "serve_ttft_ms",
+                "Time to first token (enqueue -> first token), ms.",
+            )
+            self._c_tokens = registry.counter(
+                "serve_tokens_total",
+                "Tokens of finished requests, by phase "
+                "(prompt=ingested, generated=emitted).",
+                labelnames=("phase",),
+            )
         self._h_tpot = registry.histogram(
             "serve_tpot_ms",
             "Mean inter-token time after the first token, ms.",
@@ -603,12 +697,6 @@ class InferenceEngine:
             "serve_completions_total",
             "Finished requests by terminal finish_reason.",
             labelnames=("finish_reason",),
-        )
-        self._c_tokens = registry.counter(
-            "serve_tokens_total",
-            "Tokens of finished requests, by phase "
-            "(prompt=ingested, generated=emitted).",
-            labelnames=("phase",),
         )
         self._g_queue_depth = registry.gauge(
             "serve_queue_depth", "Requests waiting for a slot."
@@ -746,7 +834,8 @@ class InferenceEngine:
         is_paged = self.paged
         dev_capacity = self.cache.capacity
 
-        def _decode_body(params, cache, tokens, active, poison, rng):
+        def _decode_body(params, cache, tokens, active, poison, rng,
+                         adapters=None):
             # `poison` is a per-slot fp32 addend on the logits — zeros
             # on the fault-free path (x + 0.0 leaves the greedy argmax
             # and the sampling distribution untouched), NaN/Inf when
@@ -770,7 +859,7 @@ class InferenceEngine:
                     )
                 )
             logits, new_cache = decode_model.apply(
-                params, tokens[:, None], cache=cache
+                params, tokens[:, None], cache=cache, adapters=adapters
             )
             # pin inactive slots' lengths (their dead-row writes drop
             # (paged) or land in junk the next prefill overwrites
@@ -963,6 +1052,98 @@ class InferenceEngine:
             _commit, donate_argnums=(0,) if self.donate_buffers else ()
         )
 
+        # ---- multi-LoRA step programs (adapter_pool engines only).
+        # Separate closures with the adapter-buffer pytree as argument
+        # 2 — the BASE programs above are byte-identical with or
+        # without a pool (their graphlint fingerprints never move).
+        # The buffers are donated alongside the cache and returned
+        # pass-through, so the output aliases the input allocation and
+        # the host re-binds `pool.buffers` each tick exactly like
+        # `self.cache`. Adapter IDS are data: any tenant mix, any
+        # park/reclaim churn, and any adapter registration all ride
+        # ONE compiled program (`mixed_trace_count` stays 1).
+        self._decode_lora_fn = None
+        self._mixed_lora_fn = None
+        self._decode_lora_jit = None
+        self._mixed_lora_jit = None
+        if self.adapter_pool is not None:
+            def _decode_lora(
+                params, cache, adapters, tokens, active, dec_adp,
+                poison, rng,
+            ):
+                self._traces["decode"] += 1
+                full = dict(
+                    adapters, ids=dec_adp,
+                    active=jnp.any(dec_adp != 0),
+                )
+                tok, bad, cache = _decode_body(
+                    params, cache, tokens, active, poison, rng,
+                    adapters=full,
+                )
+                return tok, bad, cache, adapters
+
+            def _mixed_lora(
+                params, cache, adapters, chunk_tokens, chunk_slots,
+                chunk_pos, chunk_adp, lengths_before, lengths_after,
+                completion_idx, dec_tokens, dec_active, dec_adp,
+                chunk_poison, dec_poison, rng,
+            ):
+                """`_mixed` with per-token adapter ids riding next to
+                the slot ids/positions: ``chunk_adp`` (budget,) maps
+                each packed prompt token to its pool buffer slot,
+                ``dec_adp`` (S,) each decode row. ``active`` flags
+                (any id != 0, computed in-trace) arm the `apply_lora`
+                skip branch — a pure-base tick runs zero adapter
+                FLOPs in this same program."""
+                self._traces["mixed"] += 1
+                rng_c, rng_d = jax.random.split(rng)
+                cache = cache.replace(lengths=lengths_before)
+                chunk_full = dict(
+                    adapters, ids=chunk_adp,
+                    active=jnp.any(chunk_adp != 0),
+                )
+                logits_c, cache = chunk_model.apply(
+                    params,
+                    chunk_tokens[None, :],
+                    cache=cache,
+                    chunk=(chunk_slots, chunk_pos),
+                    adapters=chunk_full,
+                )
+                logits_c = _full_logits(logits_c)
+                logits_p = logits_c[0] + chunk_poison[:, None]
+                chunk_bad = jnp.any(~jnp.isfinite(logits_p), axis=-1)
+                chunk_tok = _sample(rng_c, logits_p)
+                cache = cache.replace(lengths=lengths_after)
+                budget = chunk_tokens.shape[0]
+                has_comp = completion_idx >= 0
+                first_tok = chunk_tok[
+                    jnp.clip(completion_idx, 0, budget - 1)
+                ]
+                dec_tokens = jnp.where(has_comp, first_tok, dec_tokens)
+                dec_active = dec_active | has_comp
+                dec_full = dict(
+                    adapters, ids=dec_adp,
+                    active=jnp.any(dec_adp != 0),
+                )
+                dec_tok, dec_bad, cache = _decode_body(
+                    params, cache, dec_tokens, dec_active, dec_poison,
+                    rng_d, adapters=dec_full,
+                )
+                return (
+                    chunk_tok, dec_tok, chunk_bad, dec_bad, cache,
+                    adapters,
+                )
+
+            donate_l = (1, 2) if self.donate_buffers else ()
+            self._decode_lora_fn = _decode_lora
+            self._mixed_lora_fn = _mixed_lora
+            self._decode_lora_jit = jax.jit(
+                _decode_lora, donate_argnums=donate_l
+            )
+            self._mixed_lora_jit = jax.jit(
+                _mixed_lora, donate_argnums=donate_l
+            )
+
     def _adopt_steps(self, src: "InferenceEngine") -> None:
         """Alias `src`'s compiled step programs (and the trace-counter
         cell they increment) into this engine. The traced graphs bake
@@ -997,6 +1178,14 @@ class InferenceEngine:
             mismatches.append(
                 "cache geometry (num_slots/capacity/page_size/dtype)"
             )
+        if (src.adapter_pool is None) != (self.adapter_pool is None):
+            mismatches.append("adapter_pool presence")
+        elif self.adapter_pool is not None and _shapes(
+            src.adapter_pool.buffers
+        ) != _shapes(self.adapter_pool.buffers):
+            mismatches.append(
+                "adapter pool geometry (max_resident/max_rank)"
+            )
         if mismatches:
             raise ValueError(
                 "step_source engine is incompatible; differs in: "
@@ -1013,6 +1202,10 @@ class InferenceEngine:
         self._mixed_jit = src._mixed_jit
         self._mixed_spec_jit = src._mixed_spec_jit
         self._commit_jit = src._commit_jit
+        self._decode_lora_fn = src._decode_lora_fn
+        self._mixed_lora_fn = src._mixed_lora_fn
+        self._decode_lora_jit = src._decode_lora_jit
+        self._mixed_lora_jit = src._mixed_lora_jit
         if self.paged:
             self._fork_jit = src._fork_jit
 
@@ -1159,22 +1352,92 @@ class InferenceEngine:
         if self.registry.enabled:
             self._h_queue_wait.observe(1e3 * seconds)
 
-    def _record_ttft(self, seconds: float) -> None:
+    def _tenant_series(self, tenant: Optional[str]) -> str:
+        """Metric label for a tenant, honoring ``max_label_sets``: the
+        first sighting tries to create the tenant's series; once the
+        registry cap trips, that tenant maps to the pre-created
+        ``other`` overflow label forever. The serving hot path never
+        raises `CardinalityError` — a tenant beyond the cap still has
+        every token and TTFT accounted, just under ``other``."""
+        if tenant is None:
+            tenant = "base"
+        if tenant in self._tenant_label_ok:
+            return tenant
+        if tenant in self._tenant_overflowed:
+            return "other"
+        from rocm_apex_tpu.monitor.telemetry import CardinalityError
+
+        try:
+            # the token family first: two series per tenant, so it
+            # trips the cap before the single-series TTFT family
+            self._c_tokens.labels(phase="prompt", tenant=tenant)
+            self._c_tokens.labels(phase="generated", tenant=tenant)
+            self._h_ttft.labels(tenant=tenant)
+        except CardinalityError:
+            self._tenant_overflowed.add(tenant)
+            return "other"
+        self._tenant_label_ok.add(tenant)
+        return tenant
+
+    def _record_ttft(
+        self, seconds: float, tenant: Optional[str] = None
+    ) -> None:
         self._ttfts.append(seconds)
         if self.registry.enabled:
-            self._h_ttft.observe(1e3 * seconds)
+            if self._per_tenant:
+                self._h_ttft.observe(
+                    1e3 * seconds, tenant=self._tenant_series(tenant)
+                )
+            else:
+                self._h_ttft.observe(1e3 * seconds)
 
     def _record_completion(self, rec: Dict[str, float]) -> None:
         self._completions.append(rec)
+        tenant = rec.get("tenant")
+        if self.adapter_pool is not None:
+            # host-side per-tenant accounting keyed by the TRUE tenant
+            # name (never collapsed to "other"): the chaos isolation
+            # identity sums these against the global counters
+            tc = self._tenant_counts.setdefault(
+                tenant or "base",
+                {"completed": 0, "prompt_tokens": 0,
+                 "generated_tokens": 0},
+            )
+            tc["completed"] += 1
+            tc["prompt_tokens"] += int(rec["prompt_tokens"])
+            tc["generated_tokens"] += int(rec["new_tokens"])
         if self.registry.enabled:
             self._c_completions.inc(
                 finish_reason=rec["finish_reason"]
             )
-            self._c_tokens.inc(rec["prompt_tokens"], phase="prompt")
-            self._c_tokens.inc(rec["new_tokens"], phase="generated")
+            if self._per_tenant:
+                label = self._tenant_series(tenant)
+                self._c_tokens.inc(
+                    rec["prompt_tokens"], phase="prompt", tenant=label
+                )
+                self._c_tokens.inc(
+                    rec["new_tokens"], phase="generated", tenant=label
+                )
+            else:
+                self._c_tokens.inc(
+                    rec["prompt_tokens"], phase="prompt"
+                )
+                self._c_tokens.inc(
+                    rec["new_tokens"], phase="generated"
+                )
             self._h_e2e.observe(rec["e2e_ms"])
             if rec["new_tokens"] > 1:
                 self._h_tpot.observe(rec["tpot_ms"])
+
+    def tenant_stats(self) -> Dict[str, Dict[str, int]]:
+        """Host-side per-tenant completion accounting (true tenant
+        names — unlike the labeled metric families, never collapsed
+        into ``other``): tenant -> {completed, prompt_tokens,
+        generated_tokens}. Empty on engines without an adapter pool.
+        The per-tenant sums partition the global counters: summing
+        ``completed`` across tenants equals the completion-record
+        count, and likewise for both token phases."""
+        return {t: dict(c) for t, c in self._tenant_counts.items()}
 
     def stats(self) -> Dict[str, float]:
         """Serving telemetry as one flat name→scalar dict — the
@@ -1281,8 +1544,35 @@ class InferenceEngine:
             "page_ships": float(self._page_ships),
             "page_ship_fallbacks": float(self._page_ship_fallbacks),
         }
+        # multi-LoRA pool economics (zeros without an adapter pool):
+        # uploads/evictions/revivals witness the park-reclaim cycle,
+        # adapter_stalls counts admission skips under residency
+        # backpressure, tier_* the SLO-driven admission actions
+        if self.adapter_pool is not None:
+            snap = self.adapter_pool.snapshot()
+            adapter_stats = {
+                "adapters_registered": float(snap["registered"]),
+                "adapters_resident": float(snap["resident"]),
+                "adapter_uploads": float(snap["uploads"]),
+                "adapter_evictions": float(snap["evictions"]),
+                "adapter_revivals": float(snap["revivals"]),
+            }
+        else:
+            adapter_stats = {
+                "adapters_registered": 0.0,
+                "adapters_resident": 0.0,
+                "adapter_uploads": 0.0,
+                "adapter_evictions": 0.0,
+                "adapter_revivals": 0.0,
+            }
+        adapter_stats.update(
+            adapter_stalls=float(self._adapter_stalls),
+            tier_preemptions=float(self._tier_preemptions),
+            tier_sheds=float(self._tier_sheds),
+        )
         return {
             **paged_stats,
+            **adapter_stats,
             # robustness counters (docs/inference.md "Failure
             # semantics"): every lifecycle transition is accounted —
             # completed + shed + quarantined + cancelled + expired
@@ -1355,6 +1645,16 @@ class InferenceEngine:
                 self._g_queue_depth, self._g_slots_active,
             ):
                 metric.clear()
+            if self._per_tenant:
+                # clear() dropped every tenant series, including the
+                # pre-created overflow — rebuild the overflow series
+                # and forget the sighting sets so re-creation replays
+                # the same cap-honoring first-sighting protocol
+                self._c_tokens.labels(phase="prompt", tenant="other")
+                self._c_tokens.labels(phase="generated", tenant="other")
+                self._h_ttft.labels(tenant="other")
+                self._tenant_label_ok = {"other"}
+                self._tenant_overflowed = set()
         self._cow_forks = 0
         self._prefix_hits = 0
         self._prefix_hit_tokens = 0
@@ -1372,6 +1672,10 @@ class InferenceEngine:
         self._shed = 0
         self._watchdog_fires = 0
         self._evacuated = 0
+        self._adapter_stalls = 0
+        self._tier_preemptions = 0
+        self._tier_sheds = 0
+        self._tenant_counts.clear()
         # the watchdog's progress snapshot tracks counters just zeroed
         self._progress_mark = (0, 0, 0)
         self._last_progress = time.perf_counter()
@@ -1394,6 +1698,8 @@ class InferenceEngine:
         *,
         timeout: Optional[float] = None,
         queue_ttl: Optional[float] = None,
+        adapter_id: int = 0,
+        tenant: Optional[str] = None,
     ) -> int:
         """Queue a prompt; returns the request id. The request is
         admitted into a cache slot by a later `step` when a slot is
@@ -1413,7 +1719,16 @@ class InferenceEngine:
         SHED, never silently dropped: it still gets an id, a
         ``queue_full`` result is delivered by the next `step()` (so
         `generate` callers see it), and the ``shed`` counter ticks.
-        After `drain()` admission is closed and this raises."""
+        After `drain()` admission is closed and this raises.
+
+        ``adapter_id`` selects a LoRA adapter registered in the
+        engine's `AdapterPool` (0 = base model, always valid); the
+        request's ``tenant`` defaults to the adapter's registered
+        tenant and labels its telemetry. On a full queue with an
+        adapter pool, shedding is TIER-AWARE: an arrival outranking
+        the lowest-tier queued request sheds that victim (newest
+        within the tier) instead of itself — paying tenants keep
+        their queue positions under overload (``tier_sheds``)."""
         if self._draining:
             raise RuntimeError(
                 "engine is draining: admission is closed "
@@ -1440,6 +1755,17 @@ class InferenceEngine:
             raise ValueError(f"timeout must be > 0 s, got {timeout}")
         if queue_ttl is not None and queue_ttl <= 0:
             raise ValueError(f"queue_ttl must be > 0 s, got {queue_ttl}")
+        adapter_id = int(adapter_id)
+        if adapter_id != 0:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    f"adapter_id={adapter_id} but the engine has no "
+                    f"adapter_pool"
+                )
+            if not self.adapter_pool.known(adapter_id):
+                raise KeyError(f"unknown adapter_id {adapter_id}")
+        if tenant is None and self.adapter_pool is not None:
+            tenant = self.adapter_pool.tenant_of(adapter_id)
         if request_id is None:
             request_id = self._next_id
         self._next_id = max(self._next_id, request_id) + 1
@@ -1451,29 +1777,56 @@ class InferenceEngine:
             # bounded admission: shed-NEWEST (the queued requests keep
             # their positions — fairness under overload), accounted in
             # the completion records and delivered as a queue_full
-            # result through the next step()
+            # result through the next step(). With an adapter pool the
+            # shed is TIER-AWARE: when the arrival outranks the
+            # lowest-tier queued request, THAT victim (newest within
+            # its tier) is shed instead and the arrival takes its
+            # place at the tail.
+            victim_req, victim_idx = None, None
+            if self.adapter_pool is not None:
+                inc_tier = self.adapter_pool.tier_of(adapter_id)
+                min_tier, min_idx = inc_tier, None
+                for i, q in enumerate(self._queue):
+                    t = self.adapter_pool.tier_of(q.adapter_id)
+                    if t <= min_tier and t < inc_tier:
+                        min_tier, min_idx = t, i
+                if min_idx is not None:
+                    victim_idx = min_idx
+                    victim_req = self._queue[min_idx]
+            if victim_req is not None:
+                del self._queue[victim_idx]
+                self._tier_sheds += 1
+                shed_id = victim_req.request_id
+                shed_prompt = victim_req.prompt
+                shed_tenant = victim_req.tenant
+            else:
+                shed_id, shed_prompt, shed_tenant = (
+                    request_id, prompt, tenant
+                )
             self._shed += 1
             self._record_completion({
-                "request_id": request_id,
+                "request_id": shed_id,
                 "finish_reason": "queue_full",
-                "prompt_tokens": len(prompt),
+                "prompt_tokens": len(shed_prompt),
                 "new_tokens": 0,
                 "chunks": 0,
                 "queue_wait_ms": 0.0,
                 "ttft_ms": 0.0,
                 "tpot_ms": 0.0,
                 "e2e_ms": 0.0,
+                "tenant": shed_tenant,
             })
             self._shed_results.append(GenerationResult(
-                request_id=request_id, prompt=prompt, tokens=[],
-                finish_reason="queue_full",
+                request_id=shed_id, prompt=list(shed_prompt),
+                tokens=[], finish_reason="queue_full",
             ))
             if self.tracer.enabled:
                 self.tracer.instant(
-                    "shed", ts=now, track=f"req{request_id}",
+                    "shed", ts=now, track=f"req{shed_id}",
                     queue_depth=len(self._queue),
                 )
-            return request_id
+            if victim_req is None:
+                return request_id
         req = Request(
             request_id, prompt, max_new_tokens,
             enqueued_at=now,
@@ -1481,6 +1834,8 @@ class InferenceEngine:
             queue_deadline=(
                 (now + queue_ttl) if queue_ttl is not None else None
             ),
+            adapter_id=adapter_id,
+            tenant=tenant,
         )
         self._queue.append(req)
         if self.tracer.enabled:
@@ -1660,6 +2015,8 @@ class InferenceEngine:
                 "queue_deadline": req.queue_deadline,
                 "first_token_at": first_at,
                 "chunks": chunks,
+                "adapter_id": req.adapter_id,
+                "tenant": req.tenant,
             })
 
         for st in self._slots:
@@ -1700,6 +2057,7 @@ class InferenceEngine:
                     if payload is not None:
                         by_id[st.req.request_id]["pages"] = payload
                 self._release_slot_pages(st, slot)
+            self._release_adapter(st)
             self._slots[slot] = None
             if self.tracer.enabled:
                 self.tracer.instant(
@@ -1739,6 +2097,8 @@ class InferenceEngine:
                 "queue_deadline": st.req.queue_deadline,
                 "first_token_at": st.first_token_at,
                 "chunks": st.chunks,
+                "adapter_id": st.req.adapter_id,
+                "tenant": st.req.tenant,
             }
             if self.paged:
                 if ship_pages:
@@ -1747,6 +2107,7 @@ class InferenceEngine:
                         rec["pages"] = payload
                 self._release_slot_pages(st, slot)
                 self._push_table()
+            self._release_adapter(st)
             self._slots[slot] = None
             self._evacuated += 1
             if self.tracer.enabled:
@@ -1773,6 +2134,8 @@ class InferenceEngine:
                 "queue_deadline": req.queue_deadline,
                 "first_token_at": first_at,
                 "chunks": chunks,
+                "adapter_id": req.adapter_id,
+                "tenant": req.tenant,
             }
         return None
 
@@ -1789,6 +2152,8 @@ class InferenceEngine:
         first_token_at: float = 0.0,
         chunks: int = 0,
         pages: Optional[Dict[str, Any]] = None,
+        adapter_id: int = 0,
+        tenant: Optional[str] = None,
     ) -> int:
         """Admit a request MIGRATED from another engine, carrying the
         tokens it already emitted (an `outstanding()`/`evacuate()`
@@ -1835,6 +2200,17 @@ class InferenceEngine:
                 f"carried {len(generated)} tokens >= max_new_tokens="
                 f"{max_new_tokens}: the request already finished"
             )
+        adapter_id = int(adapter_id)
+        if adapter_id != 0:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    f"adapter_id={adapter_id} but the engine has no "
+                    f"adapter_pool"
+                )
+            if not self.adapter_pool.known(adapter_id):
+                raise KeyError(f"unknown adapter_id {adapter_id}")
+        if tenant is None and self.adapter_pool is not None:
+            tenant = self.adapter_pool.tenant_of(adapter_id)
         now = time.perf_counter()
         self._next_id = max(self._next_id, request_id) + 1
         req = Request(
@@ -1842,6 +2218,8 @@ class InferenceEngine:
             enqueued_at=enqueued_at if enqueued_at is not None else now,
             deadline=deadline,
             queue_deadline=queue_deadline,
+            adapter_id=adapter_id,
+            tenant=tenant,
         )
         if generated:
             self._preempted[request_id] = (
@@ -2190,6 +2568,17 @@ class InferenceEngine:
         self._table_dirty = True
         st.borrowed.clear()
 
+    def _release_adapter(self, st: _Slot) -> None:
+        """Drop an in-flight request's adapter residency ref, exactly
+        once per lease (``adapter_slot = -1`` marks the lease closed,
+        so overlapping teardown paths under failure recovery cannot
+        double-release). The pool slot PARKS at refcount zero — the
+        tenant's next request revives the bytes for free."""
+        if self.adapter_pool is None or st.adapter_slot < 0:
+            return
+        self.adapter_pool.release(st.req.adapter_id)
+        st.adapter_slot = -1
+
     def _preempt_for_pages(self) -> None:
         """Break a pool deadlock by preempting slots — youngest lease
         first (least recompute lost, and it frees the most recently
@@ -2232,6 +2621,7 @@ class InferenceEngine:
                     "concurrency"
                 )
             self._release_slot_pages(victim, vslot)
+            self._release_adapter(victim)
             self._slots[vslot] = None
             self._preempted[victim.req.request_id] = (
                 list(victim.generated), victim.first_token_at,
@@ -2265,21 +2655,102 @@ class InferenceEngine:
                     f"write"
                 )
 
+    def _pick_queued(self) -> Optional[Tuple[Request, int]]:
+        """Pick the next admissible queued request. Without an adapter
+        pool: plain FIFO. With one, admission is TIER-ORDERED (highest
+        tier first, FIFO within a tier) and ACQUIRE-OR-SKIP: the
+        candidate's adapter must take a residency ref NOW — if every
+        pool slot is pinned by in-flight work the candidate is skipped
+        (``adapter_stalls``; token-level backpressure, retried next
+        tick once a finishing request drops a ref — never a deadlock)
+        and a lower-tier request whose adapter IS available admits
+        instead. Returns ``(request, adapter buffer slot)`` with the
+        ref already held; the caller owns releasing it."""
+        if not self._queue:
+            return None
+        if self.adapter_pool is None:
+            return self._queue.popleft(), 0
+        order = sorted(
+            range(len(self._queue)),
+            key=lambda i: (
+                -self.adapter_pool.tier_of(
+                    self._queue[i].adapter_id
+                ),
+                i,
+            ),
+        )
+        for i in order:
+            req = self._queue[i]
+            aslot = self.adapter_pool.acquire(req.adapter_id)
+            if aslot is None:
+                self._adapter_stalls += 1
+                continue
+            del self._queue[i]
+            return req, aslot
+        return None
+
     def _admit_free_slots(self, now: float) -> None:
         """Lease free slots to queued requests (host bookkeeping; the
         prefill work itself is scheduled by the caller). With prefix
         sharing, a prompt that extends an already-materialized page
         chain maps those pages by REFERENCE and starts its prefill
-        cursor past them — the shared tokens are never re-prefilled."""
+        cursor past them — the shared tokens are never re-prefilled.
+
+        With ``tier_preemption`` and a fully-occupied engine, a queued
+        request outranking the lowest-tier in-flight one preempts that
+        victim (youngest lease within the tier; at most one per tick)
+        through the PR-8 requeue path — tokens kept, cache recomputed
+        on re-admission, greedy output unchanged."""
+        if (
+            self.tier_preemption
+            and self.adapter_pool is not None
+            and self._queue
+            and all(s is not None for s in self._slots)
+        ):
+            top = max(
+                self.adapter_pool.tier_of(q.adapter_id)
+                for q in self._queue
+            )
+            victim, vslot, vtier = None, -1, 0
+            for slot, st in enumerate(self._slots):
+                t = self.adapter_pool.tier_of(st.req.adapter_id)
+                if (
+                    victim is None or t < vtier
+                    or (t == vtier and st.leased_at >= victim.leased_at)
+                ):
+                    victim, vslot, vtier = st, slot, t
+            if top > vtier:
+                if self.paged:
+                    self._release_slot_pages(victim, vslot)
+                self._release_adapter(victim)
+                self._slots[vslot] = None
+                self._preempted[victim.req.request_id] = (
+                    list(victim.generated), victim.first_token_at,
+                    victim.chunks,
+                )
+                self._queue.appendleft(victim.req)
+                self._tier_preemptions += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "tier_preempt",
+                        track=f"req{victim.req.request_id}",
+                        slot=vslot, tier=vtier, over=top,
+                    )
         for slot in range(self.num_slots):
             if self._slots[slot] is not None or not self._queue:
                 continue
-            req = self._queue.popleft()
+            picked = self._pick_queued()
+            if picked is None:
+                # nothing admissible this tick (adapter residency
+                # backpressure) — no point probing the other slots
+                break
+            req, aslot = picked
             self._admitted += 1
             self._record_queue_wait(now - req.enqueued_at)
             st = _Slot(
                 req=req, generated=[], pos=0, cursor=0,
                 prefix=list(req.prompt), leased_at=now,
+                adapter_slot=aslot,
             )
             carried = self._preempted.pop(req.request_id, None)
             if carried is not None:
@@ -2407,6 +2878,7 @@ class InferenceEngine:
                 continue
             if self.paged:
                 self._release_slot_pages(st, slot)
+            self._release_adapter(st)
             self._slots[slot] = None
             if st.generated:
                 self._preempted[st.req.request_id] = (
@@ -2473,6 +2945,7 @@ class InferenceEngine:
             "ttft_ms": 0.0,
             "tpot_ms": 0.0,
             "e2e_ms": 1e3 * (now - req.enqueued_at),
+            "tenant": req.tenant,
         })
         if self.tracer.enabled:
             self.tracer.instant(
@@ -2596,6 +3069,14 @@ class InferenceEngine:
         # a `logits` fault poisons ONE slot's rows with NaN/Inf
         chunk_poison = np.zeros((budget,), np.float32)
         dec_poison = np.zeros((S,), np.float32)
+        # per-row adapter BUFFER slots (multi-LoRA): pad rows stay 0 =
+        # base = zero factors, so padding is exact with or without
+        # adapters in the batch
+        pool = self.adapter_pool
+        chunk_adp = dec_adp = None
+        if pool is not None:
+            chunk_adp = np.zeros((budget,), np.int32)
+            dec_adp = np.zeros((S,), np.int32)
         poison_slot = -1
         poison_val = 0.0
         if self.faults.enabled:
@@ -2673,6 +3154,8 @@ class InferenceEngine:
                 chunk_pos[used:used + n] = np.arange(
                     st.cursor, st.cursor + n
                 )
+                if chunk_adp is not None:
+                    chunk_adp[used:used + n] = st.adapter_slot
                 packed.append((slot, n, st.cursor))
                 st.cursor += n
                 st.pos = st.cursor
@@ -2779,6 +3262,15 @@ class InferenceEngine:
         completion_idx = np.full((S,), -1, np.int32)
         for slot, idx, fed in completions:
             completion_idx[slot] = idx if fed else -1
+        if dec_adp is not None:
+            # only rows the fused decode actually emits carry their
+            # adapter slot; dead rows stay 0 so a pure-base tick's
+            # `active` skip condition sees all-zero ids exactly
+            for slot, st in enumerate(self._slots):
+                if st is None:
+                    continue
+                if active[slot] or completion_idx[slot] >= 0:
+                    dec_adp[slot] = st.adapter_slot
         if self.paged:
             if (
                 used == 0 and not active.any() and completions == []
@@ -2873,28 +3365,62 @@ class InferenceEngine:
             t0 = time.perf_counter()
 
             def _mixed_thunk():
-                chunk_tok, dec_tok, cbad, dbad, cache = self._mixed_jit(
-                    self.params, self.cache,
-                    jnp.asarray(chunk_tokens), jnp.asarray(chunk_slots),
-                    jnp.asarray(chunk_pos), jnp.asarray(lengths_before),
-                    jnp.asarray(lengths_after),
-                    jnp.asarray(completion_idx), jnp.asarray(dec_tokens),
-                    jnp.asarray(active), jnp.asarray(chunk_poison),
-                    jnp.asarray(dec_poison), rng,
-                )
+                if pool is None:
+                    chunk_tok, dec_tok, cbad, dbad, cache = (
+                        self._mixed_jit(
+                            self.params, self.cache,
+                            jnp.asarray(chunk_tokens),
+                            jnp.asarray(chunk_slots),
+                            jnp.asarray(chunk_pos),
+                            jnp.asarray(lengths_before),
+                            jnp.asarray(lengths_after),
+                            jnp.asarray(completion_idx),
+                            jnp.asarray(dec_tokens),
+                            jnp.asarray(active),
+                            jnp.asarray(chunk_poison),
+                            jnp.asarray(dec_poison), rng,
+                        )
+                    )
+                    adapters = None
+                else:
+                    # the SAME fused chunk+decode program for any
+                    # adapter mix — ids are data, so adapter add /
+                    # park / reclaim churn never retraces
+                    (chunk_tok, dec_tok, cbad, dbad, cache,
+                     adapters) = self._mixed_lora_jit(
+                        self.params, self.cache, pool.buffers,
+                        jnp.asarray(chunk_tokens),
+                        jnp.asarray(chunk_slots),
+                        jnp.asarray(chunk_pos),
+                        jnp.asarray(chunk_adp),
+                        jnp.asarray(lengths_before),
+                        jnp.asarray(lengths_after),
+                        jnp.asarray(completion_idx),
+                        jnp.asarray(dec_tokens),
+                        jnp.asarray(active),
+                        jnp.asarray(dec_adp),
+                        jnp.asarray(chunk_poison),
+                        jnp.asarray(dec_poison), rng,
+                    )
                 self._maybe_fail_fetch()
                 # ONE batched value fetch per tick (= the device sync)
                 # — never a per-request scalar pull; the nonfinite
                 # flags ride the same fetch
                 return jax.device_get(
                     (chunk_tok, dec_tok, cbad, dbad)
-                ), cache
+                ), cache, adapters
 
             with profiler.annotate(
                 "inference/mixed_step",
                 chunk_tokens=used, decodes=int(active.sum()),
             ):
-                fetched, self.cache = self._call_device(_mixed_thunk)
+                fetched, self.cache, new_adp = self._call_device(
+                    _mixed_thunk
+                )
+            if new_adp is not None:
+                # re-bind the donated adapter buffers (like the cache,
+                # they only move forward on step success)
+                pool.buffers = new_adp
             chunk_out, dec_out, chunk_bad, dec_bad = fetched
             t1 = time.perf_counter()
             self._prefill_seconds += t1 - t0
@@ -2918,18 +3444,33 @@ class InferenceEngine:
             t0 = time.perf_counter()
 
             def _decode_thunk():
-                tok, bad, cache = self._decode_jit(
-                    self.params, self.cache, jnp.asarray(dec_tokens),
-                    jnp.asarray(active), jnp.asarray(dec_poison), rng,
-                )
+                if pool is None:
+                    tok, bad, cache = self._decode_jit(
+                        self.params, self.cache,
+                        jnp.asarray(dec_tokens),
+                        jnp.asarray(active), jnp.asarray(dec_poison),
+                        rng,
+                    )
+                    adapters = None
+                else:
+                    tok, bad, cache, adapters = self._decode_lora_jit(
+                        self.params, self.cache, pool.buffers,
+                        jnp.asarray(dec_tokens), jnp.asarray(active),
+                        jnp.asarray(dec_adp), jnp.asarray(dec_poison),
+                        rng,
+                    )
                 self._maybe_fail_fetch()
                 # value fetch = device sync
-                return jax.device_get((tok, bad)), cache
+                return jax.device_get((tok, bad)), cache, adapters
 
             with profiler.annotate(
                 "inference/decode", batch=int(active.sum())
             ):
-                fetched, self.cache = self._call_device(_decode_thunk)
+                fetched, self.cache, new_adp = self._call_device(
+                    _decode_thunk
+                )
+            if new_adp is not None:
+                pool.buffers = new_adp
             dec_out, dec_bad = fetched
             t1 = time.perf_counter()
             self._decode_seconds += t1 - t0
@@ -3221,6 +3762,7 @@ class InferenceEngine:
         self._evicted += 1
         if self.paged:
             self._release_slot_pages(state, slot)
+        self._release_adapter(state)
         finished_at = time.perf_counter()
         req = state.req
         n_new = len(state.generated)
@@ -3244,6 +3786,7 @@ class InferenceEngine:
                 / max(n_new - 1, 1)
             ),
             "e2e_ms": 1e3 * (finished_at - req.enqueued_at),
+            "tenant": req.tenant,
         })
         if self.tracer.enabled:
             track = f"req{req.request_id}"

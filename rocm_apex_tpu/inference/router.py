@@ -369,6 +369,7 @@ class ReplicaRouter:
         self._quarantines = 0
         self._rejoins = 0
         self._affinity_hits = 0
+        self._adapter_affinity_hits = 0
         self._kills = 0
         self._handoffs = 0
         self._page_migrations = 0
@@ -384,7 +385,8 @@ class ReplicaRouter:
         self._c_events = registry.counter(
             "router_events_total",
             "Fleet lifecycle events (migration, page_migration, "
-            "handoff, quarantine, rejoin, affinity_hit, kill, shed, "
+            "handoff, quarantine, rejoin, affinity_hit, "
+            "adapter_affinity_hit, kill, shed, "
             "drain_replica).",
             labelnames=("event",),
         )
@@ -455,17 +457,41 @@ class ReplicaRouter:
         *,
         timeout: Optional[float] = None,
         queue_ttl: Optional[float] = None,
+        adapter_id: int = 0,
+        tenant: Optional[str] = None,
     ) -> int:
         """Queue a prompt with the fleet; same contract as
         `InferenceEngine.add_request` (ids, deadlines, bounded
         admission with shed-newest ``queue_full`` results delivered by
         the next `step()`, raises once draining). Placement happens at
-        the next tick's dispatch."""
+        the next tick's dispatch; non-base ``adapter_id`` requests
+        prefer replicas where the adapter is already resident."""
         if self._draining:
             raise RuntimeError(
                 "router is draining: admission is closed "
                 "(drain() was called)"
             )
+        adapter_id = int(adapter_id)
+        if adapter_id != 0:
+            pools = [
+                rep.engine.adapter_pool for rep in self._replicas
+                if rep.engine.adapter_pool is not None
+            ]
+            if not pools:
+                raise ValueError(
+                    "adapter_id requires replicas built with an "
+                    "AdapterPool"
+                )
+            if not any(p.known(adapter_id) for p in pools):
+                raise KeyError(
+                    f"adapter {adapter_id} is not registered with any "
+                    f"replica's pool"
+                )
+            if tenant is None:
+                for p in pools:
+                    if p.known(adapter_id):
+                        tenant = p.tenant_of(adapter_id)
+                        break
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -510,6 +536,8 @@ class ReplicaRouter:
             ),
             "first_token_at": 0.0,
             "chunks": 0,
+            "adapter_id": adapter_id,
+            "tenant": tenant,
         })
         return request_id
 
@@ -697,6 +725,9 @@ class ReplicaRouter:
             "replica_quarantines": float(self._quarantines),
             "replica_rejoins": float(self._rejoins),
             "affinity_hits": float(self._affinity_hits),
+            "adapter_affinity_hits": float(
+                self._adapter_affinity_hits
+            ),
             "replica_kills": float(self._kills),
             "handoffs": float(self._handoffs),
             "page_migrations": float(self._page_migrations),
@@ -860,6 +891,8 @@ class ReplicaRouter:
                 first_token_at=rec["first_token_at"],
                 chunks=rec["chunks"],
                 pages=rec.pop("pages", None),
+                adapter_id=rec.get("adapter_id", 0),
+                tenant=rec.get("tenant"),
             )
             self._assigned[rid] = rep.index
             self._mirror[rid] = rec
@@ -888,6 +921,28 @@ class ReplicaRouter:
             ]
             if classed:
                 candidates = classed
+        # adapter affinity: a replica where the request's adapter is
+        # already resident skips the host->device upload (and spares
+        # some other tenant an eviction); narrow to those replicas
+        # when any exist, then let prefix affinity / least-loaded pick
+        # within them
+        aid = rec.get("adapter_id", 0)
+        if aid:
+            resident = [
+                rep for rep in candidates
+                if rep.engine.adapter_pool is not None
+                and rep.engine.adapter_pool.resident(aid)
+            ]
+            if resident:
+                candidates = resident
+                self._adapter_affinity_hits += 1
+                self._count_event("adapter_affinity_hit")
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "adapter_affinity_hit",
+                        track=f"req{rec['request_id']}",
+                        adapter=aid,
+                    )
         # prefix affinity: the replica already holding the longest
         # materialized prefix of this prompt skips that much prefill
         # (recovered requests carry tokens and re-prefill anyway, so

@@ -32,6 +32,7 @@ import numpy as np
 
 from rocm_apex_tpu.normalization import MixedFusedLayerNorm
 from rocm_apex_tpu.ops.flash_attention import flash_attention
+from rocm_apex_tpu.ops.lora import apply_lora
 from rocm_apex_tpu.ops.xentropy import softmax_cross_entropy_loss_fused
 from rocm_apex_tpu.ops.softmax import (
     scaled_masked_softmax,
@@ -361,6 +362,7 @@ class ParallelAttention(nn.Module):
         deterministic: bool = True,
         cache=None,
         chunk=None,
+        adapters=None,
     ):
         cfg = self.cfg
         tp = cfg.tensor_parallel_size or (
@@ -461,6 +463,15 @@ class ParallelAttention(nn.Module):
             name="query_key_value",
             **_sp_kwargs(cfg, tp),
         )(x)
+        if adapters is not None:
+            # multi-LoRA serving: segmented per-token low-rank delta
+            # gathered from the packed adapter pool (ops/lora.py).
+            # Adapter ids are DATA, so any tenant mix — and any
+            # park/reclaim churn in the pool — rides this same trace.
+            qkv = apply_lora(
+                qkv, x, adapters["qkv"], adapters["ids"],
+                adapters["active"],
+            )
         qkv = qkv.reshape(b, sq, nh_local, 3 * hd)
         if cfg.context_parallel_axis is not None and (
             not use_flash or self.attn_mask_type != "causal" or dropout_active
@@ -1052,6 +1063,11 @@ class ParallelAttention(nn.Module):
             name="dense",
             **_sp_kwargs(cfg, tp),
         )(ctx)
+        if adapters is not None:
+            y = apply_lora(
+                y, ctx, adapters["dense"], adapters["ids"],
+                adapters["active"],
+            )
         if cache is not None:
             return y, new_kv
         return y
@@ -1087,6 +1103,7 @@ class ParallelTransformerLayer(nn.Module):
         chain: bool = False,
         cache=None,
         chunk=None,
+        adapters=None,
     ):
         cfg = self.cfg
         if (delta is not None or chain) and (
@@ -1123,7 +1140,8 @@ class ParallelTransformerLayer(nn.Module):
             # inside the LN kernel
             ln1, x = ln1_mod(delta.astype(x.dtype), residual=x)
         attn = ParallelAttention(cfg, self.attn_mask_type, name="self_attention")(
-            ln1, attention_mask, deterministic, cache, chunk
+            ln1, attention_mask, deterministic, cache, chunk,
+            adapters=adapters,
         )
         new_kv = None
         if cache is not None:
@@ -1190,8 +1208,13 @@ class ParallelTransformer(nn.Module):
         deterministic: bool = True,
         cache=None,
         chunk=None,
+        adapters=None,
     ):
         n = self.num_layers or self.cfg.num_layers
+        if adapters is not None and cache is None:
+            raise ValueError(
+                "adapters= is a KV-cached serving feature; pass cache="
+            )
         layer_cls = ParallelTransformerLayer
         # remat is a training memory feature; cached inference never
         # differentiates, so it skips the rematerialized layer class
@@ -1241,11 +1264,27 @@ class ParallelTransformer(nn.Module):
                             else cache.v_scale[i]
                         ),
                     ),)
+                layer_adapters = None
+                if adapters is not None:
+                    # per-layer (P, h, r)/(P, r, o) pool slices; ids
+                    # and the pure-base skip flag are shared across
+                    # the stack (computed once per apply)
+                    layer_adapters = {
+                        "qkv": (
+                            adapters["qkv"][0][i], adapters["qkv"][1][i]
+                        ),
+                        "dense": (
+                            adapters["dense"][0][i],
+                            adapters["dense"][1][i],
+                        ),
+                        "ids": adapters["ids"],
+                        "active": adapters["active"],
+                    }
                 x, kv_i = layer_cls(
                     self.cfg, self.attn_mask_type, name=f"layer_{i}"
                 )(
                     x, attention_mask, deterministic, None, False,
-                    layer_cache, chunk,
+                    layer_cache, chunk, adapters=layer_adapters,
                 )
                 if chunk is not None and len(chunk) == 3:
                     # speculative chunk: each layer's trailing (kq, vq)
@@ -1468,7 +1507,12 @@ class GPTModel(nn.Module):
         cache=None,
         chunk=None,
         loss_reduction: Optional[str] = None,
+        adapters=None,
     ):
+        if adapters is not None and cache is None:
+            raise ValueError(
+                "adapters= is a KV-cached serving feature; pass cache="
+            )
         if chunk is not None and cache is None:
             raise ValueError(
                 "chunked prefill writes into a KV cache; pass cache= "
@@ -1501,7 +1545,8 @@ class GPTModel(nn.Module):
                     )
             x = self.embedding(tokens, position_ids, deterministic)
             out = self.transformer(
-                x, deterministic=deterministic, cache=cache, chunk=chunk
+                x, deterministic=deterministic, cache=cache, chunk=chunk,
+                adapters=adapters,
             )
             sp_exit = _sp_active(self.cfg, _resolve_tp(self.cfg))
             if chunk is not None and len(chunk) == 3:

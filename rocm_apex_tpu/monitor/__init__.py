@@ -96,6 +96,7 @@ from rocm_apex_tpu.monitor.slo import (
     BurnRule,
     SLO,
     SLOMonitor,
+    TenantSLOBoard,
 )
 from rocm_apex_tpu.monitor.telemetry import (
     DEFAULT_REGISTRY,
@@ -151,6 +152,7 @@ __all__ = [
     "RegistryWriter",
     "SLO",
     "SLOMonitor",
+    "TenantSLOBoard",
     "BurnRule",
     "DEFAULT_BURN_RULES",
     "TelemetryServer",

@@ -41,6 +41,7 @@ __all__ = [
     "BurnRule",
     "SLO",
     "SLOMonitor",
+    "TenantSLOBoard",
     "DEFAULT_BURN_RULES",
 ]
 
@@ -95,6 +96,12 @@ class SLO:
     ``objective`` is the target good fraction in (0, 1); the error
     budget is ``1 - objective``. ``windows`` is a sequence of
     `BurnRule`.
+
+    ``labels`` narrows a LATENCY SLO to one label series of its
+    histogram (e.g. ``labels={"tenant": "acme"}`` over the engine's
+    ``serve_ttft_ms{tenant=}`` family) — the per-tenant SLO feed
+    `TenantSLOBoard` builds on. Without labels the reads aggregate
+    across every series, exactly as before.
     """
 
     def __init__(
@@ -107,6 +114,7 @@ class SLO:
         series: Optional[Histogram] = None,
         threshold: Optional[float] = None,
         windows: Sequence[BurnRule] = DEFAULT_BURN_RULES,
+        labels: Optional[Dict[str, str]] = None,
     ):
         if not 0.0 < objective < 1.0:
             raise ValueError(
@@ -123,6 +131,11 @@ class SLO:
             raise ValueError("latency SLO needs threshold=")
         if ratio and total is None:
             raise ValueError("ratio SLO needs total=")
+        if labels and not latency:
+            raise ValueError(
+                "labels= narrows a latency SLO's histogram series; "
+                "ratio counters read unlabeled totals"
+            )
         self.name = name
         self.objective = float(objective)
         self.budget = 1.0 - self.objective
@@ -135,12 +148,15 @@ class SLO:
         self.windows = tuple(windows)
         if not self.windows:
             raise ValueError("need at least one BurnRule")
+        self.labels: Dict[str, str] = dict(labels) if labels else {}
 
     def read(self) -> Tuple[float, float]:
         """Current cumulative (good, total) event counts."""
         if self.series is not None:
-            total = self.series.count()
-            good = self.series.good_below(self.threshold)
+            total = self.series.count(**self.labels)
+            good = self.series.good_below(
+                self.threshold, **self.labels
+            )
             return float(good), float(total)
         return float(self.good.total()), float(self.total.total())
 
@@ -345,3 +361,100 @@ class SLOMonitor:
                 ],
             }
         return {"slos": per_slo, "events": list(self.events)}
+
+
+class TenantSLOBoard:
+    """One `SLOMonitor` per tenant over a labeled latency family —
+    the per-tenant burn-rate plane of multi-LoRA serving (ISSUE 18).
+
+    Each tenant gets its OWN monitor holding one latency `SLO`
+    narrowed to that tenant's label series (``labels={"tenant": t}``
+    on the engine's ``serve_ttft_ms{tenant=}`` family), so one
+    tenant's burst burns ONLY that tenant's budget: the isolation the
+    chaos scenario asserts is structural, not statistical — the other
+    monitors literally never read the bursting tenant's series.
+
+    Tenants appear lazily (`ensure`) or in bulk from the engine's
+    host accounting (`sync(engine)` walks `tenant_stats()` — tenants
+    past the metric cardinality cap share the ``other`` overflow
+    label and therefore one shared board entry, matching exactly what
+    the metric plane can actually distinguish). `tick`/`alerts` fan
+    out to every monitor; `alerts` returns entries tagged with their
+    tenant. The board feeds ADMISSION as well as paging: the engine's
+    tier scheduler is the actuator — a burning tenant's tier can be
+    dropped by the operator loop reading `status()`.
+    """
+
+    def __init__(
+        self,
+        series: Histogram,
+        *,
+        objective: float = 0.99,
+        threshold_ms: float = 500.0,
+        windows: Sequence[BurnRule] = DEFAULT_BURN_RULES,
+        registry: Optional[MetricRegistry] = None,
+        tracer=None,
+        history: int = 4096,
+    ):
+        self.series = series
+        self.objective = float(objective)
+        self.threshold_ms = float(threshold_ms)
+        self.windows = tuple(windows)
+        self._registry = registry
+        self._tracer = tracer
+        self._history = int(history)
+        self.monitors: Dict[str, SLOMonitor] = {}
+
+    def ensure(self, tenant: str) -> SLOMonitor:
+        """The tenant's monitor, created on first sight."""
+        mon = self.monitors.get(tenant)
+        if mon is None:
+            mon = SLOMonitor(
+                [SLO(
+                    f"ttft/{tenant}", self.objective,
+                    series=self.series,
+                    threshold=self.threshold_ms,
+                    windows=self.windows,
+                    labels={"tenant": tenant},
+                )],
+                registry=self._registry,
+                tracer=self._tracer,
+                history=self._history,
+            )
+            self.monitors[tenant] = mon
+        return mon
+
+    def sync(self, engine) -> None:
+        """Create monitors for every tenant the engine has finished a
+        request for (host accounting keys, mapped through the metric
+        plane's overflow: tenants beyond the cardinality cap share
+        the ``other`` board entry — per-label series is all a labeled
+        read can distinguish)."""
+        for tenant in engine.tenant_stats():
+            label = engine._tenant_series(tenant)
+            self.ensure(label)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        for mon in self.monitors.values():
+            mon.tick(now=now)
+
+    def alerts(
+        self, now: Optional[float] = None
+    ) -> List[Dict[str, Any]]:
+        """Firing alerts across every tenant, each entry carrying its
+        ``tenant`` key; rising edges accumulate in each monitor's
+        ``events`` as usual."""
+        out: List[Dict[str, Any]] = []
+        for tenant, mon in self.monitors.items():
+            for entry in mon.alerts(now=now):
+                entry = dict(entry, tenant=tenant)
+                out.append(entry)
+        return out
+
+    def status(
+        self, now: Optional[float] = None
+    ) -> Dict[str, Any]:
+        return {
+            tenant: mon.status(now=now)
+            for tenant, mon in self.monitors.items()
+        }

@@ -1,0 +1,112 @@
+"""Segmented multi-LoRA delta: per-token gather→bmm over a packed
+adapter pool (Punica arXiv 2310.18547 BGMV / S-LoRA arXiv 2311.03285).
+
+The serving engine's continuous batch mixes requests from different
+tenants, each carrying a low-rank adapter ``(A, B)`` of rank ``r``.
+The naive per-adapter approach — materialize each adapter's dense
+delta ``W_a = B_a @ A_a`` (shape ``(h, o)``) or loop a matmul per
+adapter group — either burns ``O(P·h·o)`` HBM or fragments the batch
+and the trace. The segmented pass here keeps ONE fused program at any
+adapter mix:
+
+    delta[t] = (x[t] @ A[ids[t]]) @ B[ids[t]]        # (t, o)
+
+i.e. gather the per-token ``(h, r)`` / ``(r, o)`` factors out of the
+rank-padded packed pool and contract through the rank bottleneck —
+``O(t·r·(h+o))`` FLOPs, never a dense ``(h, o)`` delta and never a
+``(P, …)`` broadcast (tools/graphlint.py `serve_mixed_lora` pins both
+as `NoMaterialization` contracts). Plain jnp einsums: XLA lowers the
+gathered batched contractions well on every backend, and the op stays
+trace-stable (fixed shapes — adapter ids are DATA, so swapping
+adapters never retraces).
+
+Pool slot 0 is the base model: its factors are zeros, so a base token
+riding a mixed batch receives an exact ``+0.0`` (the engine's poison
+idiom — greedy argmax untouched). A batch with NO adapter tokens
+skips the gathers entirely through `apply_lora`'s `lax.cond`: the
+false branch is the identity (zero dot_generals — "provably zero
+extra FLOPs on pure-base traffic", checkable by walking the cond
+branches exactly like `CollectiveContract`'s skip-branch proofs).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["segmented_lora_delta", "apply_lora", "pad_rank"]
+
+
+def pad_rank(a, b, max_rank: int, alpha: float = None):
+    """Pad one adapter's host factors to the pool's uniform rank.
+
+    ``a``: (h, r) down-projection; ``b``: (r, o) up-projection. The
+    returned ``(h, max_rank)`` / ``(max_rank, o)`` pair is zero-padded
+    along the rank axis — padding contributes ``x @ 0 = 0``, so the
+    padded product is EXACT, not approximate. The conventional LoRA
+    scale ``alpha / r`` (default ``alpha = r``, i.e. scale 1) is
+    folded into ``b`` here, once at registration, so the serving-path
+    op never multiplies by it."""
+    import numpy as np
+
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        raise ValueError(
+            f"adapter factors must be (h, r)/(r, o) with matching "
+            f"rank, got {a.shape} / {b.shape}"
+        )
+    r = a.shape[1]
+    if r > max_rank:
+        raise ValueError(
+            f"adapter rank {r} exceeds the pool max_rank {max_rank}"
+        )
+    scale = (float(alpha) if alpha is not None else float(r)) / float(r)
+    a_p = np.zeros((a.shape[0], max_rank), np.float32)
+    b_p = np.zeros((max_rank, b.shape[1]), np.float32)
+    a_p[:, :r] = a
+    b_p[:r, :] = b * scale
+    return a_p, b_p
+
+
+def segmented_lora_delta(x, A, B, ids):
+    """The segmented gather→bmm pass: ``(x[t] @ A[ids[t]]) @ B[ids[t]]``.
+
+    ``x``: (t, h) packed token activations; ``A``: (P, h, r) /
+    ``B``: (P, r, o) rank-padded pool; ``ids``: (t,) int32 pool slot
+    per token (0 = base, zeros). Returns the (t, o) delta in fp32 —
+    the caller casts onto its stream dtype.
+
+    Contracts through the rank bottleneck first (``tmp`` is (t, r)),
+    so the only gathered intermediates are the (t, h, r)/(t, r, o)
+    per-token factor views — linear in tokens, never in adapters."""
+    xf = x.astype(jnp.float32)
+    Ag = jnp.take(A, ids, axis=0)                 # (t, h, r)
+    tmp = jnp.einsum("th,thr->tr", xf, Ag)        # rank bottleneck
+    Bg = jnp.take(B, ids, axis=0)                 # (t, r, o)
+    return jnp.einsum("tr,tro->to", tmp, Bg)      # (t, o)
+
+
+def apply_lora(y, x, pair: Tuple, ids, active):
+    """Add the segmented delta onto a projection output, under the
+    pure-base skip branch.
+
+    ``y``: (b, s, o) projection output; ``x``: (b, s, h) the SAME
+    input the projection consumed; ``pair``: (A, B) pool factors;
+    ``ids``: (b·s,) per-token pool slots; ``active``: traced scalar
+    bool, True iff any id != 0 this call (the engine computes it once
+    per apply). The ``lax.cond`` false branch returns ``y`` untouched
+    — a pure-base tick executes zero adapter FLOPs while the trace
+    (and `mixed_trace_count`) never changes."""
+    A, B = pair
+    b, s, o = y.shape
+
+    def _on(ops):
+        y_, x_ = ops
+        d = segmented_lora_delta(x_.reshape(b * s, -1), A, B, ids)
+        return y_ + d.reshape(b, s, o).astype(y_.dtype)
+
+    def _off(ops):
+        return ops[0]
+
+    return jax.lax.cond(active, _on, _off, (y, x))

@@ -18,9 +18,11 @@ read-back:
   round-over-round delta for the newest value;
 * exits nonzero when a GUARDED metric (default: the headline per-chip
   throughputs — ``gpt_train_tokens_per_sec_per_chip``,
-  ``gpt_serve_tokens_per_sec_per_chip`` and the equal-chip-count
+  ``gpt_serve_tokens_per_sec_per_chip``, the equal-chip-count
   serving A/Bs ``gpt_serve_tokens_per_sec_per_chip_tp2`` /
-  ``..._disagg`` from ``bench.py serve --tp=2`` / ``--disagg``) drops
+  ``..._disagg`` from ``bench.py serve --tp=2`` / ``--disagg``, and
+  the multi-LoRA aggregate ``gpt_serve_adapter_tokens_per_sec_per_chip``
+  from ``bench.py serve --adapters=N``) drops
   more than ``--threshold`` (default 10%) between its two most recent
   appearances. Rounds that didn't run a guarded bench don't trip the
   gate (the diff pairs the last two rounds that DID); ``--warn-only``
@@ -43,6 +45,7 @@ DEFAULT_GUARDS = (
     "gpt_serve_tokens_per_sec_per_chip",
     "gpt_serve_tokens_per_sec_per_chip_tp2",
     "gpt_serve_tokens_per_sec_per_chip_disagg",
+    "gpt_serve_adapter_tokens_per_sec_per_chip",
 )
 
 

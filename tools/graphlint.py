@@ -31,6 +31,11 @@ nothing here compiles and the suite's compile cache stays warm):
 * ``serve_mixed`` — the serving engine's fused prefill+decode mixed
   step lowered with donated cache buffers: KV-cache donation verified
   from the executable's own ``args_info``, no whole-batch logits.
+* ``serve_mixed_lora`` — the multi-LoRA variant of the same step
+  (packed `AdapterPool` buffers + per-token adapter ids): segmented
+  gather->bmm deltas proven to never materialize a dense per-token
+  delta weight or an every-adapter broadcast; cache AND adapter
+  buffers donated.
 * ``serve_mixed_tp2`` — the same mixed step under shard_map at tp=2
   (sequence-parallel chunk + collective-matmul rings, head-sharded
   paged pools): exactly 8 ppermute ring hops, no full-seq full-width
@@ -249,6 +254,74 @@ def _build_serve_mixed():
     return subject, rules
 
 
+def _build_serve_mixed_lora():
+    """The multi-LoRA fused mixed step (ISSUE 18): the serve_mixed
+    geometry plus an `AdapterPool`'s packed rank-padded buffers as
+    donated argument 2 and per-token adapter ids next to the slot
+    ids/positions. The NoMaterialization rule is the segmented-delta
+    proof: no per-token DENSE delta weight (budget, h, out) and no
+    all-adapters broadcast (P, budget, h) may appear — the delta must
+    stay contracted through the (budget, r) bottleneck. Cache AND
+    adapter buffers are donated (the host re-binds `pool.buffers`
+    each tick exactly like the cache)."""
+    from rocm_apex_tpu.inference import (
+        AdapterPool, InferenceEngine, SamplingParams,
+    )
+    from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
+
+    cfg = GPTConfig(
+        vocab_size=96, hidden_size=32, num_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        tensor_parallel_size=1, params_dtype=jnp.float32,
+        dtype=jnp.float32,
+    )
+    model = GPTModel(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), toks)
+    pool = AdapterPool(
+        cfg.num_layers, cfg.hidden_size, max_resident=4, max_rank=4,
+    )
+    eng = InferenceEngine(
+        model, params, num_slots=2, max_prompt_len=8, capacity=24,
+        sampling=SamplingParams(temperature=0.0),
+        prefill_token_budget=16, donate_buffers=True,
+        adapter_pool=pool,
+    )
+    budget, ns = eng.prefill_token_budget, eng.num_slots
+    h, pp = cfg.hidden_size, 4
+    i32 = lambda shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+    subject = LintSubject.from_jit(
+        "serve_mixed_lora", eng._mixed_lora_jit,
+        eng.params, eng.cache, pool.buffers,
+        i32((budget,)), i32((budget,)), i32((budget,)),   # tokens/slots/pos
+        i32((budget,)),                                   # chunk adapter ids
+        i32((ns,)), i32((ns,)),                           # lengths before/after
+        -jnp.ones((ns,), jnp.int32),                      # completion_idx
+        i32((ns,)), jnp.zeros((ns,), bool),               # dec tokens/active
+        i32((ns,)),                                       # dec adapter ids
+        jnp.zeros((budget,), jnp.float32),                # chunk poison
+        jnp.zeros((ns,), jnp.float32),                    # dec poison
+        jax.random.PRNGKey(0),
+    )
+    rules = [
+        PrecisionPolicy(compute_dtype="float32"),
+        NoMaterialization(forbidden_shapes=(
+            (ns, 24, 96),          # whole-batch logits (serve_mixed)
+            (budget, h, 3 * h),    # dense per-token qkv delta weight
+            (budget, h, h),        # dense per-token proj delta weight
+            (pp, budget, h),       # every-adapter broadcast of the chunk
+        )),
+        # cache (arg 1) AND adapter buffers (arg 2) donated in place
+        DonationContract(
+            min_bytes=float("inf"),
+            require=("args[0][1]", "args[0][2]"),
+        ),
+        TraceStability(),
+    ]
+    return subject, rules
+
+
 def _build_serve_mixed_tp2():
     """The tp=2 fused mixed step under shard_map (PR-17 disaggregated
     serving rung 1): sequence-parallel chunk with collective-matmul
@@ -403,6 +476,7 @@ REGISTRY = {
     "gpt_train_bf16": _build_gpt_train_bf16,
     "packed_opt": _build_packed_opt,
     "serve_mixed": _build_serve_mixed,
+    "serve_mixed_lora": _build_serve_mixed_lora,
     "serve_mixed_tp2": _build_serve_mixed_tp2,
     "spcm_tp2": _build_spcm_tp2,
     "zero_int8": _build_zero_int8,
