@@ -1264,6 +1264,178 @@ def bench_serve(budget: int = 0, whole_prompt: bool = False,
                 f"vs uniform-fleet p95 {p95_u:.0f} ms "
                 f"(ratio = vs_baseline)",
             )
+        if chaos >= 0:
+            # ---- fleet-causal observability pass: the same disagg
+            # fleet under a seeded mid-decode replica kill, with a
+            # tracer on the router AND every replica, the retrace
+            # sentinel armed after warmup, and the sensor ring
+            # sampling the router registry every tick. Three
+            # acceptance properties: (1) ONE merged Perfetto trace in
+            # which every request — handed off, migrated, or failed
+            # over — is a single trace_id lifeline with exactly one
+            # finish; (2) the /timeseries-style windowed rate and
+            # quantile queries agree with the cumulative counters and
+            # see the seeded load doubling before the cumulative
+            # average moves; (3) zero post-warmup XLA compiles.
+            import os
+            import tempfile
+
+            from rocm_apex_tpu.inference import Fault, FaultPlan
+            from rocm_apex_tpu.monitor.timeseries import TimeSeriesStore
+            from rocm_apex_tpu.monitor.trace import Tracer, trace_lifelines
+
+            rng_c = np.random.RandomState(chaos)
+            victim = int(rng_c.randint(0, n_rep))
+            kill_tick = int(rng_c.randint(4, 9))
+
+            def run_observed(traced):
+                # one tick-deterministic driver for both passes: the
+                # throwaway pass (traced=False) replays the exact
+                # schedule first so every kill-path page-ship gather
+                # shape is compiled BEFORE the sentinel arms — the
+                # traced pass then proves the serving fabric itself
+                # never retraces
+                plan = FaultPlan([
+                    Fault(site="replica_kill", tick=kill_tick,
+                          payload={"replica": victim}),
+                ], seed=chaos)
+                router = ReplicaRouter(
+                    model, params, replicas=n_rep,
+                    engine_kwargs=dict(ekw),
+                    replica_classes=classes, faults=plan,
+                    tracer=Tracer() if traced else None,
+                    retrace_policy="count" if traced else None,
+                )
+                for i in range(router.num_replicas):
+                    router.replica(i).generate(
+                        prompts[:num_slots], max_new_tokens=3
+                    )
+                    router.replica(i).reset_stats()
+                    if traced:
+                        # fresh per-replica tracers AFTER warmup:
+                        # merge_traces gives each its own process id
+                        router.replica(i).tracer = Tracer()
+                ts = None
+                if traced:
+                    ts = TimeSeriesStore(
+                        router.registry, interval=1e-4, capacity=8192,
+                    )
+                    router.timeseries = ts  # step() ticks it
+                    router.arm_retrace_sentinel()
+                done = {}
+
+                def tick():
+                    for r in router.step():
+                        done[r.request_id] = r
+
+                # wave 1: paced arrival, one prompt per two ticks
+                # (the kill fires mid-wave); drain to empty
+                for p in prompts:
+                    router.add_request(p, max_new_tokens=dis_new)
+                    tick()
+                    tick()
+                guard = 0
+                while router.has_work():
+                    tick()
+                    guard += 1
+                    assert guard < 20000, "observability pass wedged"
+                t2 = time.perf_counter()
+                # wave 2: the seeded load doubling — twice the
+                # request count offered in one burst
+                for p in prompts + prompts:
+                    router.add_request(p, max_new_tokens=dis_new)
+                while router.has_work():
+                    tick()
+                    guard += 1
+                    assert guard < 20000, "observability pass wedged"
+                return router, ts, done, t2, plan
+
+            run_observed(traced=False)
+            router_t, ts, done, t2, plan = run_observed(traced=True)
+            n_req = 3 * len(prompts)
+            s_t = router_t.stats()
+            assert plan.fires.get("replica_kill", 0) == 1, (
+                f"replica_kill never fired: {dict(plan.fires)}"
+            )
+            assert len(done) == s_t["submitted"] == n_req, (
+                len(done), s_t,
+            )
+            assert s_t["replica_kills"] >= 1 and s_t["migrations"] >= 1
+            assert s_t["handoffs"] >= 1, s_t
+            # (1) the merged fleet trace: one lifeline per request,
+            # exactly one finish each, and the handed-off / failed-over
+            # ones span more than one replica process
+            trace_path = os.path.join(
+                tempfile.gettempdir(),
+                f"rocm_apex_disagg_fleet_trace_{os.getpid()}.json",
+            )
+            n_events = router_t.export_merged_trace(trace_path)
+            lines = trace_lifelines(router_t.merged_trace())
+            assert len(lines) == n_req, (len(lines), n_req)
+            bad = {
+                t: d for t, d in lines.items() if d["finishes"] != 1
+            }
+            assert not bad, f"lifelines without exactly one finish: {bad}"
+            multi = [
+                t for t, d in lines.items()
+                if len([p for p in d["pids"] if p > 1]) > 1
+            ]
+            assert len(multi) >= len(prompts), (
+                f"{int(s_t['handoffs'])} handoffs + "
+                f"{int(s_t['migrations'])} migrations but only "
+                f"{len(multi)} of {n_req} lifelines span 2+ replicas"
+            )
+            # (2) sensor plane vs cumulative counters: the full-ring
+            # delta reproduces the cumulative completion count, and
+            # the burst-window rate/quantile move while the
+            # cumulative average still blends the paced wave
+            t_end = time.perf_counter()
+            assert int(round(ts.delta("router_ttft_ms"))) == n_req, (
+                ts.delta("router_ttft_ms"), n_req,
+            )
+            w_burst = t_end - t2
+            rate_burst = ts.rate("router_ttft_ms", window=w_burst)
+            rate_full = ts.rate("router_ttft_ms")
+            assert rate_burst > rate_full, (
+                f"burst-window finish rate {rate_burst:.2f}/s did not "
+                f"exceed the cumulative average {rate_full:.2f}/s"
+            )
+            q_burst = ts.quantile_over(
+                "router_ttft_ms", 0.95, window=w_burst
+            )
+            q_full = ts.quantile_over("router_ttft_ms", 0.95)
+            assert q_burst >= q_full, (q_burst, q_full)
+            # (3) the armed sentinel saw no compile anywhere in the
+            # process across kill, failover, migration, and handoff
+            tripped = int(router_t.retrace_sentinel.tripped)
+            assert tripped == 0, (
+                f"post-warmup compiles: "
+                f"{router_t.retrace_sentinel.status()}"
+            )
+            print(
+                f"serve[disagg x{n_rep} chaos seed={chaos}]: killed "
+                f"replica {victim} at tick {kill_tick}; {n_req} "
+                f"requests -> {len(lines)} lifelines, every finish "
+                f"exactly once, {len(multi)} span 2+ replicas "
+                f"({int(s_t['handoffs'])} handoffs, "
+                f"{int(s_t['migrations'])} migrations); merged trace "
+                f"{n_events} events -> {trace_path}; sensor ring "
+                f"{len(ts)} samples: burst rate {rate_burst:.2f}/s vs "
+                f"cumulative {rate_full:.2f}/s, ttft p95 "
+                f"{q_burst:.0f}ms vs {q_full:.0f}ms; retrace sentinel "
+                f"{tripped} post-warmup compiles",
+                file=sys.stderr,
+            )
+            _report(
+                "gpt_serve_retrace_sentinel", float(tripped),
+                "compiles", 1.0,
+                f"post-warmup XLA compiles observed by the armed "
+                f"retrace sentinel across the chaos-composed disagg "
+                f"pass (seed={chaos}: replica kill, failover "
+                f"migration, prefill->decode handoffs, load "
+                f"doubling); every request one trace_id lifeline "
+                f"with exactly one finish in the merged fleet trace",
+            )
         return
 
     if replicas >= 2:
@@ -2916,13 +3088,14 @@ if __name__ == "__main__":
         )
     if kwargs.get("disagg") and any(
         k in kwargs
-        for k in ("whole_prompt", "shared_prefix", "spec_k", "chaos",
+        for k in ("whole_prompt", "shared_prefix", "spec_k",
                   "slo", "metrics_port", "trace", "paged", "kv_dtype",
                   "tp")
     ):
         raise SystemExit(
             "--disagg runs its own equal-chip-count fleet A/B; it "
-            "composes with --replicas/--budget/--page-size only"
+            "composes with --replicas/--budget/--page-size/--chaos "
+            "only (--chaos adds the fleet-trace observability pass)"
         )
     if kwargs.get("spec_k", 0) < 0:
         raise SystemExit("--spec-k must be >= 0")

@@ -63,7 +63,7 @@ from rocm_apex_tpu.inference.paging import (
     PrefixStore,
 )
 from rocm_apex_tpu.inference.sampling import sample
-from rocm_apex_tpu.monitor.trace import NULL_TRACER
+from rocm_apex_tpu.monitor.trace import NULL_TRACER, mint_trace_id
 from rocm_apex_tpu.ops._pallas import on_tpu
 
 __all__ = [
@@ -188,6 +188,11 @@ class Request:
     # and the tenant it bills to (None on a base engine)
     adapter_id: int = 0
     tenant: Optional[str] = None
+    # fleet-causal trace context: minted ONCE at admission (router or
+    # first engine to see the request) and carried verbatim across
+    # every migration/failover/handoff hop, so merged timelines group
+    # a request's whole fleet lifeline under one id ("" = untraced).
+    trace_id: str = ""
 
 
 @dataclasses.dataclass
@@ -355,6 +360,8 @@ class InferenceEngine:
         step_source: Optional["InferenceEngine"] = None,
         adapter_pool=None,
         tier_preemption: bool = False,
+        retrace_policy: Optional[str] = None,
+        timeseries=None,
     ):
         cfg = model.cfg
         tp = int(cfg.tensor_parallel_size or 1)
@@ -705,6 +712,23 @@ class InferenceEngine:
             "serve_slots_active", "Slots holding a live request."
         )
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # ---- runtime retrace sentinel + sensor plane (ISSUE 19) ------
+        # retrace_policy="count"|"raise" arms a RetraceSentinel at the
+        # next reset_stats() (the bench contract's warmed-up-now
+        # marker): a jax compile landing after that boundary is the
+        # latency cliff the one-compiled-trace invariant forbids —
+        # "count" observes it into xla_compiles_post_warmup_total,
+        # "raise" fails the NEXT step() (never mid-compile). The
+        # timeseries ring, when attached, samples the registry once
+        # per `interval` from the step loop.
+        self.retrace_sentinel = None
+        if retrace_policy is not None:
+            from rocm_apex_tpu.monitor.trace import RetraceSentinel
+
+            self.retrace_sentinel = RetraceSentinel(
+                registry, policy=retrace_policy, tracer=self.tracer
+            )
+        self.timeseries = timeseries
         # ---- robustness layer (ISSUE 12) -----------------------------
         # faults: the chaos harness (NO_FAULTS = the shared null plan —
         # call sites pay one `enabled` attribute check, the NULL_TRACER
@@ -1679,6 +1703,12 @@ class InferenceEngine:
         # the watchdog's progress snapshot tracks counters just zeroed
         self._progress_mark = (0, 0, 0)
         self._last_progress = time.perf_counter()
+        if self.retrace_sentinel is not None:
+            # reset_stats() IS the bench contract's warmed-up-now
+            # marker (warm generate(), reset, measure a clean window)
+            # — arm the sentinel here: compiles from now on are the
+            # retraces the one-compiled-trace invariant forbids
+            self.retrace_sentinel.arm()
 
     def cache_bytes(self) -> int:
         """Device bytes held by the KV cache (pools/buffers + scales +
@@ -1700,6 +1730,7 @@ class InferenceEngine:
         queue_ttl: Optional[float] = None,
         adapter_id: int = 0,
         tenant: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Queue a prompt; returns the request id. The request is
         admitted into a cache slot by a later `step` when a slot is
@@ -1769,6 +1800,11 @@ class InferenceEngine:
         if request_id is None:
             request_id = self._next_id
         self._next_id = max(self._next_id, request_id) + 1
+        # fleet-causal context: mint at first admission, carry a
+        # caller-supplied id verbatim (the router mints once per
+        # admitted request and every hop re-presents the same id)
+        if trace_id is None:
+            trace_id = mint_trace_id()
         now = time.perf_counter()
         if (
             self.max_queue is not None
@@ -1799,9 +1835,10 @@ class InferenceEngine:
                 shed_id = victim_req.request_id
                 shed_prompt = victim_req.prompt
                 shed_tenant = victim_req.tenant
+                shed_trace = victim_req.trace_id
             else:
-                shed_id, shed_prompt, shed_tenant = (
-                    request_id, prompt, tenant
+                shed_id, shed_prompt, shed_tenant, shed_trace = (
+                    request_id, prompt, tenant, trace_id
                 )
             self._shed += 1
             self._record_completion({
@@ -1824,6 +1861,7 @@ class InferenceEngine:
                 self.tracer.instant(
                     "shed", ts=now, track=f"req{shed_id}",
                     queue_depth=len(self._queue),
+                    request_id=shed_id, trace_id=shed_trace,
                 )
             if victim_req is None:
                 return request_id
@@ -1836,6 +1874,7 @@ class InferenceEngine:
             ),
             adapter_id=adapter_id,
             tenant=tenant,
+            trace_id=trace_id,
         )
         self._queue.append(req)
         if self.tracer.enabled:
@@ -1843,6 +1882,7 @@ class InferenceEngine:
                 "enqueue", ts=req.enqueued_at,
                 track=f"req{request_id}",
                 prompt_tokens=len(prompt), max_new_tokens=max_new_tokens,
+                request_id=request_id, trace_id=trace_id,
             )
         return request_id
 
@@ -1875,6 +1915,13 @@ class InferenceEngine:
             # (host-side sets; the compiled programs are untouched)
             self._g_queue_depth.set(self.num_queued)
             self._g_slots_active.set(self.num_active)
+        if self.timeseries is not None:
+            self.timeseries.tick()
+        if self.retrace_sentinel is not None:
+            # tick-boundary enforcement — under policy="raise" a
+            # post-warmup compile fails HERE, never inside the jax
+            # callback mid-compile
+            self.retrace_sentinel.check()
         return out
 
     def cancel(self, request_id: int) -> Optional[GenerationResult]:
@@ -1901,6 +1948,8 @@ class InferenceEngine:
                     self.tracer.instant(
                         "cancel", ts=now, track=f"req{request_id}",
                         slot=slot, generated=len(st.generated),
+                        request_id=request_id,
+                        trace_id=st.req.trace_id,
                     )
                 return self._evict(slot, st, "cancelled")
         return None
@@ -2017,6 +2066,7 @@ class InferenceEngine:
                 "chunks": chunks,
                 "adapter_id": req.adapter_id,
                 "tenant": req.tenant,
+                "trace_id": req.trace_id,
             })
 
         for st in self._slots:
@@ -2063,6 +2113,8 @@ class InferenceEngine:
                 self.tracer.instant(
                     "evacuate", track=f"req{st.req.request_id}",
                     slot=slot, generated=len(st.generated),
+                    request_id=st.req.request_id,
+                    trace_id=st.req.trace_id,
                 )
         if self.paged:
             self._push_table()
@@ -2099,6 +2151,7 @@ class InferenceEngine:
                 "chunks": st.chunks,
                 "adapter_id": st.req.adapter_id,
                 "tenant": st.req.tenant,
+                "trace_id": st.req.trace_id,
             }
             if self.paged:
                 if ship_pages:
@@ -2114,6 +2167,8 @@ class InferenceEngine:
                 self.tracer.instant(
                     "evacuate", track=f"req{request_id}",
                     slot=slot, generated=len(st.generated),
+                    request_id=request_id,
+                    trace_id=st.req.trace_id,
                 )
             return rec
         for i, req in enumerate(self._queue):
@@ -2136,6 +2191,7 @@ class InferenceEngine:
                 "chunks": chunks,
                 "adapter_id": req.adapter_id,
                 "tenant": req.tenant,
+                "trace_id": req.trace_id,
             }
         return None
 
@@ -2154,6 +2210,7 @@ class InferenceEngine:
         pages: Optional[Dict[str, Any]] = None,
         adapter_id: int = 0,
         tenant: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Admit a request MIGRATED from another engine, carrying the
         tokens it already emitted (an `outstanding()`/`evacuate()`
@@ -2213,6 +2270,10 @@ class InferenceEngine:
             tenant = self.adapter_pool.tenant_of(adapter_id)
         now = time.perf_counter()
         self._next_id = max(self._next_id, request_id) + 1
+        # carry the hop's trace context verbatim; mint only if this
+        # request was never traced (a bare resume outside the router)
+        if not trace_id:
+            trace_id = mint_trace_id()
         req = Request(
             request_id, prompt, max_new_tokens,
             enqueued_at=enqueued_at if enqueued_at is not None else now,
@@ -2220,6 +2281,7 @@ class InferenceEngine:
             queue_deadline=queue_deadline,
             adapter_id=adapter_id,
             tenant=tenant,
+            trace_id=trace_id,
         )
         if generated:
             self._preempted[request_id] = (
@@ -2232,6 +2294,7 @@ class InferenceEngine:
             self.tracer.instant(
                 "resume", ts=now, track=f"req{request_id}",
                 carried=len(generated),
+                request_id=request_id, trace_id=trace_id,
             )
         return request_id
 
@@ -2633,6 +2696,8 @@ class InferenceEngine:
                 self.tracer.instant(
                     "preempt", track=f"req{victim.req.request_id}",
                     slot=vslot, generated=len(victim.generated),
+                    request_id=victim.req.request_id,
+                    trace_id=victim.req.trace_id,
                 )
 
     def _guard_capacity(self, active) -> None:
@@ -2735,6 +2800,8 @@ class InferenceEngine:
                         "tier_preempt",
                         track=f"req{victim.req.request_id}",
                         slot=vslot, tier=vtier, over=top,
+                        request_id=victim.req.request_id,
+                        trace_id=victim.req.trace_id,
                     )
         for slot in range(self.num_slots):
             if self._slots[slot] is not None or not self._queue:
@@ -2781,6 +2848,8 @@ class InferenceEngine:
                     self.tracer.add_span(
                         "queue_wait", req.enqueued_at, now,
                         track=f"req{req.request_id}", slot=slot,
+                        request_id=req.request_id,
+                        trace_id=req.trace_id,
                     )
                 continue
             if self._store is not None:
@@ -2803,11 +2872,14 @@ class InferenceEngine:
                             "prefix_hit", track=f"req{req.request_id}",
                             tokens=matched, pages=len(pages),
                             partial_tokens=partial, slot=slot,
+                            request_id=req.request_id,
+                            trace_id=req.trace_id,
                         )
             if self.tracer.enabled:
                 self.tracer.add_span(
                     "queue_wait", req.enqueued_at, now,
                     track=f"req{req.request_id}", slot=slot,
+                    request_id=req.request_id, trace_id=req.trace_id,
                 )
 
     # -- robustness internals ------------------------------------------
@@ -2890,6 +2962,8 @@ class InferenceEngine:
                 self.tracer.instant(
                     "requeue", track=f"req{st.req.request_id}",
                     slot=slot, generated=len(st.generated),
+                    request_id=st.req.request_id,
+                    trace_id=st.req.trace_id,
                 )
         if self.paged:
             self._push_table()
@@ -2950,7 +3024,8 @@ class InferenceEngine:
         if self.tracer.enabled:
             self.tracer.instant(
                 "finish", ts=now, track=f"req{req.request_id}",
-                reason=reason,
+                reason=reason, request_id=req.request_id,
+                trace_id=req.trace_id,
             )
         return GenerationResult(
             request_id=req.request_id, prompt=list(req.prompt),
@@ -2972,6 +3047,7 @@ class InferenceEngine:
         if self.tracer.enabled:
             self.tracer.instant(
                 "quarantine", track=f"req{rid}", slot=slot, why=why,
+                request_id=rid, trace_id=st.req.trace_id,
             )
         if self.flight_recorder is not None:
             self.flight_recorder.record(
@@ -3793,9 +3869,11 @@ class InferenceEngine:
             self.tracer.add_span(
                 "decode", first_at, finished_at,
                 track=track, tokens=n_new, slot=slot,
+                request_id=req.request_id, trace_id=req.trace_id,
             )
             self.tracer.instant(
                 "finish", ts=finished_at, track=track, reason=reason,
+                request_id=req.request_id, trace_id=req.trace_id,
             )
         return GenerationResult(
             request_id=req.request_id,

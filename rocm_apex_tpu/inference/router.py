@@ -84,7 +84,11 @@ from rocm_apex_tpu.inference.engine import (
     InferenceEngine,
 )
 from rocm_apex_tpu.inference.faults import NO_FAULTS, FaultPlan
-from rocm_apex_tpu.monitor.trace import NULL_TRACER
+from rocm_apex_tpu.monitor.trace import (
+    NULL_TRACER,
+    merge_traces,
+    mint_trace_id,
+)
 
 __all__ = [
     "ReplicaRouter", "SharedPrefixRegistry", "REPLICA_STATES",
@@ -226,6 +230,8 @@ class ReplicaRouter:
         rejoin_after: int = 8,
         registry=None,
         tracer=None,
+        retrace_policy: Optional[str] = None,
+        timeseries=None,
     ):
         self.faults = faults if faults is not None else NO_FAULTS
         self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -414,6 +420,23 @@ class ReplicaRouter:
             labelnames=("replica_class",),
         )
         self._g_healthy.set(len(self._replicas))
+        # runtime retrace sentinel (ISSUE 19): jax compile events are
+        # process-global, so ONE router-held sentinel guards the whole
+        # fleet — arm it after warmup (`arm_retrace_sentinel()`, or any
+        # replica's reset_stats when per-replica sentinels are used);
+        # "raise" fails the next fleet tick on a post-warmup compile
+        self.retrace_sentinel = None
+        if retrace_policy is not None:
+            from rocm_apex_tpu.monitor.trace import RetraceSentinel
+
+            self.retrace_sentinel = RetraceSentinel(
+                registry, policy=retrace_policy, tracer=self.tracer
+            )
+        # sensor plane: the ring samples the ROUTER registry (its own
+        # families); pass TimeSeriesStore(router.merged_registry) for
+        # fleet-wide series — snapshot() on a merged registry costs a
+        # merge per sample, so pick the interval accordingly
+        self.timeseries = timeseries
 
     # ------------------------------------------------------------------
     # public surface (mirrors InferenceEngine)
@@ -459,13 +482,19 @@ class ReplicaRouter:
         queue_ttl: Optional[float] = None,
         adapter_id: int = 0,
         tenant: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Queue a prompt with the fleet; same contract as
         `InferenceEngine.add_request` (ids, deadlines, bounded
         admission with shed-newest ``queue_full`` results delivered by
         the next `step()`, raises once draining). Placement happens at
         the next tick's dispatch; non-base ``adapter_id`` requests
-        prefer replicas where the adapter is already resident."""
+        prefer replicas where the adapter is already resident.
+
+        Admission mints the request's fleet-causal ``trace_id`` (one
+        per admitted request, NOT per attempt): it rides every
+        dispatch, migration, failover, and handoff hop so
+        `merged_trace` renders the whole lifeline under one id."""
         if self._draining:
             raise RuntimeError(
                 "router is draining: admission is closed "
@@ -511,6 +540,8 @@ class ReplicaRouter:
         if request_id is None:
             request_id = self._next_id
         self._next_id = max(self._next_id, request_id) + 1
+        if trace_id is None:
+            trace_id = mint_trace_id()
         now = time.perf_counter()
         self._submitted += 1
         if (
@@ -523,6 +554,12 @@ class ReplicaRouter:
                 request_id=request_id, prompt=prompt, tokens=[],
                 finish_reason="queue_full",
             ))
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "shed", ts=now, track=f"req{request_id}",
+                    queue_depth=len(self._pending),
+                    request_id=request_id, trace_id=trace_id,
+                )
             return request_id
         self._pending.append({
             "request_id": request_id,
@@ -538,7 +575,14 @@ class ReplicaRouter:
             "chunks": 0,
             "adapter_id": adapter_id,
             "tenant": tenant,
+            "trace_id": trace_id,
         })
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "admit", ts=now, track=f"req{request_id}",
+                prompt_tokens=len(prompt),
+                request_id=request_id, trace_id=trace_id,
+            )
         return request_id
 
     def step(self) -> List[GenerationResult]:
@@ -601,6 +645,12 @@ class ReplicaRouter:
         if self.registry.enabled:
             self._g_healthy.set(self.healthy_replicas)
             self._g_pending.set(len(self._pending))
+        if self.timeseries is not None:
+            self.timeseries.tick()
+        if self.retrace_sentinel is not None:
+            # tick-boundary enforcement: a post-warmup compile
+            # anywhere in the process fails HERE under "raise"
+            self.retrace_sentinel.check()
         return out
 
     def cancel(self, request_id: int) -> Optional[GenerationResult]:
@@ -678,9 +728,13 @@ class ReplicaRouter:
         rep.state = "drained"
         self._count_event("drain_replica")
         if self.tracer.enabled:
+            # name every migrated request so the merged timeline can
+            # group this replica-scoped event into each lifeline
             self.tracer.instant(
                 "drain_replica", track="router", replica=i,
                 migrated=len(recs),
+                request_ids=[r["request_id"] for r in recs],
+                trace_ids=[r.get("trace_id", "") for r in recs],
             )
 
     def rejoin_replica(self, i: int) -> None:
@@ -698,7 +752,13 @@ class ReplicaRouter:
         self._rejoins += 1
         self._count_event("rejoin")
         if self.tracer.enabled:
-            self.tracer.instant("rejoin", track="router", replica=i)
+            # a rejoining replica is provably empty (reopen() checked)
+            # — state what it rejoins AS rather than omitting context
+            self.tracer.instant(
+                "rejoin", track="router", replica=i,
+                replica_class=rep.replica_class,
+                after_ticks=self._tick - rep.quarantined_at,
+            )
 
     # ------------------------------------------------------------------
     # telemetry
@@ -757,6 +817,40 @@ class ReplicaRouter:
                 merged.merge_from(rep.engine.registry)
         return merged
 
+    def merged_trace(self, labels: Optional[List[str]] = None
+                     ) -> Dict[str, Any]:
+        """ONE Perfetto-loadable body for the whole fleet: the
+        router's tracer plus every replica's, folded by
+        `monitor.trace.merge_traces` — the router renders as process
+        1, replica ``i`` as process ``i+2``, and a migrated request's
+        hops line up as a single ``trace_id`` lifeline. Default
+        labels: ``router``, ``replica<i>:<class>``."""
+        tracers = [self.tracer] + [
+            rep.engine.tracer for rep in self._replicas
+        ]
+        if labels is None:
+            labels = ["router"] + [
+                f"replica{rep.index}:{rep.replica_class}"
+                for rep in self._replicas
+            ]
+        return merge_traces(tracers, labels)
+
+    def export_merged_trace(self, path: str) -> int:
+        """`merged_trace` to disk; returns the event count."""
+        import json
+
+        body = self.merged_trace()
+        with open(path, "w") as f:
+            json.dump(body, f)
+        return len(body["traceEvents"])
+
+    def arm_retrace_sentinel(self) -> None:
+        """Mark the fleet's warmup boundary (no-op without a
+        ``retrace_policy=``): compiles after this are retraces —
+        counted, or fatal at the next tick under "raise"."""
+        if self.retrace_sentinel is not None:
+            self.retrace_sentinel.arm()
+
     def health(self) -> Dict[str, Any]:
         """Fleet liveness for `/healthz`: healthy while ANY replica
         remains in rotation — one dead replica is the fabric working,
@@ -773,8 +867,9 @@ class ReplicaRouter:
 
     def varz(self) -> Dict[str, Any]:
         """Per-replica detail for `/varz`: rotation state, failure
-        latches, and each engine's own health signals."""
-        return {
+        latches, and each engine's own health signals — plus the
+        retrace sentinel's status when one is armed on the fleet."""
+        out: Dict[str, Any] = {
             "router": self.stats(),
             "replica_detail": [
                 {
@@ -795,6 +890,9 @@ class ReplicaRouter:
                 for rep in self._replicas
             ],
         }
+        if self.retrace_sentinel is not None:
+            out["retrace_sentinel"] = self.retrace_sentinel.status()
+        return out
 
     # ------------------------------------------------------------------
     # internals
@@ -893,6 +991,7 @@ class ReplicaRouter:
                 pages=rec.pop("pages", None),
                 adapter_id=rec.get("adapter_id", 0),
                 tenant=rec.get("tenant"),
+                trace_id=rec.get("trace_id"),
             )
             self._assigned[rid] = rep.index
             self._mirror[rid] = rec
@@ -900,6 +999,7 @@ class ReplicaRouter:
                 self.tracer.instant(
                     "dispatch", ts=now, track=f"req{rid}",
                     replica=rep.index, carried=len(rec["generated"]),
+                    request_id=rid, trace_id=rec.get("trace_id"),
                 )
 
     def _place(
@@ -942,6 +1042,8 @@ class ReplicaRouter:
                         "adapter_affinity_hit",
                         track=f"req{rec['request_id']}",
                         adapter=aid,
+                        request_id=rec["request_id"],
+                        trace_id=rec.get("trace_id"),
                     )
         # prefix affinity: the replica already holding the longest
         # materialized prefix of this prompt skips that much prefill
@@ -970,6 +1072,8 @@ class ReplicaRouter:
                         "affinity_hit",
                         track=f"req{rec['request_id']}",
                         replica=best.index, tokens=best_tokens,
+                        request_id=rec["request_id"],
+                        trace_id=rec.get("trace_id"),
                     )
                 return best
         # least-loaded: fewest owned requests, then fewest live pages,
@@ -1074,6 +1178,8 @@ class ReplicaRouter:
                         "handoff", track=f"req{rec['request_id']}",
                         replica=rep.index,
                         shipped="pages" in rec,
+                        request_id=rec["request_id"],
+                        trace_id=rec.get("trace_id"),
                     )
                 self._requeue([rec])
 
@@ -1095,6 +1201,7 @@ class ReplicaRouter:
                     "migrate", track=f"req{rid}",
                     carried=len(rec["generated"]),
                     shipped="pages" in rec,
+                    request_id=rid, trace_id=rec.get("trace_id"),
                 )
 
     def _quarantine_replica(self, rep: _Replica, why: str) -> None:
@@ -1114,6 +1221,8 @@ class ReplicaRouter:
             self.tracer.instant(
                 "quarantine_replica", track="router",
                 replica=rep.index, why=why, migrated=len(recs),
+                request_ids=[r["request_id"] for r in recs],
+                trace_ids=[r.get("trace_id", "") for r in recs],
             )
 
     def _kill_replica(self, rep: _Replica) -> None:
@@ -1141,6 +1250,8 @@ class ReplicaRouter:
             self.tracer.instant(
                 "kill_replica", track="router", replica=rep.index,
                 recovered=len(recs),
+                request_ids=[r["request_id"] for r in recs],
+                trace_ids=[r.get("trace_id", "") for r in recs],
             )
 
     def _consult_faults(self) -> None:

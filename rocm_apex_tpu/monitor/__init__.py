@@ -27,7 +27,13 @@ this package is the shared layer the ROADMAP's production story needs:
   thread-safe ring buffer, exported as Perfetto-loadable Chrome trace
   JSON and aligned with device captures via
   `jax.profiler.TraceAnnotation` — the serving engine's per-request
-  timelines and the train loop's step spans ride it;
+  timelines and the train loop's step spans ride it. Fleet-causal on
+  top: the router mints a `trace_id` per admitted request that rides
+  every hop, `merge_traces` folds N replica tracers + the router
+  tracer into ONE Perfetto JSON (per-replica process ids), and
+  `RetraceSentinel` subscribes to jax's compilation events to turn
+  "the trace count stays 1" into a runtime gate
+  (``retrace_policy="raise"``);
 * **flight recorder** (`recorder.py`): last-k step snapshots plus
   in-graph per-param-group nonfinite probes; on a NaN/Inf anomaly it
   dumps a jsonl bundle naming the offending group — a mid-run NaN
@@ -43,7 +49,12 @@ this package is the shared layer the ROADMAP's production story needs:
   and ``/varz`` (JSON incl. device-memory watermarks). The serving
   engine's ``stats()`` rides the registry; `RegistryWriter` joins
   training runs to the same plane; disabled registries follow the
-  `NULL_TRACER` zero-overhead idiom (`NULL_REGISTRY`).
+  `NULL_TRACER` zero-overhead idiom (`NULL_REGISTRY`). The
+  time-series sensor plane (`timeseries.py`) rides the same registry:
+  `TimeSeriesStore` keeps a fixed-memory ring of periodic
+  ``snapshot()`` samples and answers the windowed
+  `rate`/`delta`/`quantile_over` queries the elastic-fleet
+  controller's sensors need, served at ``/timeseries``.
 
 See docs/observability.md for the full tour; `rocm_apex_tpu.profiler`
 remains the trace-capture layer (device timelines), while this package
@@ -108,7 +119,18 @@ from rocm_apex_tpu.monitor.telemetry import (
     MetricRegistry,
     log_buckets,
 )
-from rocm_apex_tpu.monitor.trace import NULL_TRACER, Tracer
+from rocm_apex_tpu.monitor.timeseries import TimeSeriesStore
+from rocm_apex_tpu.monitor.trace import (
+    COMPILE_EVENT_PHASES,
+    NULL_TRACER,
+    RetraceError,
+    RetraceSentinel,
+    Tracer,
+    export_merged_trace,
+    merge_traces,
+    mint_trace_id,
+    trace_lifelines,
+)
 
 __all__ = [
     "Metrics",
@@ -139,6 +161,14 @@ __all__ = [
     "TraceStability",
     "Tracer",
     "NULL_TRACER",
+    "mint_trace_id",
+    "merge_traces",
+    "export_merged_trace",
+    "trace_lifelines",
+    "RetraceSentinel",
+    "RetraceError",
+    "COMPILE_EVENT_PHASES",
+    "TimeSeriesStore",
     "FlightRecorder",
     "group_nonfinite",
     "MetricRegistry",

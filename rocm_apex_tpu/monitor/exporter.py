@@ -13,8 +13,16 @@ the text exposition format needs none):
   can act on it; healthy (or no health_fn) answers 200.
 * ``GET /varz`` — one JSON dump for humans and scripts: the registry
   snapshot, `device_memory_stats()` watermarks for every local
-  device, SLO burn-rate status when an `SLOMonitor` is attached, and
-  anything the optional ``varz_fn`` adds.
+  device, SLO burn-rate status when an `SLOMonitor` is attached,
+  per-tenant SLO state when a `TenantSLOBoard` is attached, the
+  `TimeSeriesStore.head` summary when a timeseries ring is attached,
+  and anything the optional ``varz_fn`` adds.
+* ``GET /timeseries`` — the full windowed sensor ring
+  (`TimeSeriesStore.series_json`: per-sample cumulative totals,
+  per-interval rates, windowed histogram p50/p95) when a
+  ``timeseries=`` store is attached; 404 otherwise. This is the
+  endpoint the elastic-fleet controller scrapes for "what happened in
+  the last 30s" — cumulative `/metrics` cannot answer that.
 
 **Security note:** the server binds ``127.0.0.1`` by default and
 serves read-only GETs with no auth — telemetry is an information
@@ -112,6 +120,20 @@ class _Handler(BaseHTTPRequestHandler):
                     200, json.dumps(ctx.varz()).encode(),
                     "application/json",
                 )
+            elif path == "/timeseries":
+                if ctx.timeseries is None:
+                    self._send(
+                        404, b"no timeseries store attached\n",
+                        "text/plain",
+                    )
+                else:
+                    self._send(
+                        200,
+                        json.dumps(
+                            ctx.timeseries.series_json()
+                        ).encode(),
+                        "application/json",
+                    )
             else:
                 self._send(404, b"not found\n", "text/plain")
         except Exception as exc:  # noqa: BLE001 - scrape must not kill
@@ -147,11 +169,15 @@ class TelemetryServer:
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         varz_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         slo_monitor=None,
+        tenant_board=None,
+        timeseries=None,
     ):
         self._registry_source = registry
         self.health_fn = health_fn
         self.varz_fn = varz_fn
         self.slo_monitor = slo_monitor
+        self.tenant_board = tenant_board
+        self.timeseries = timeseries
         self._host = host
         self._want_port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -189,6 +215,10 @@ class TelemetryServer:
             out["device_memory"] = []
         if self.slo_monitor is not None:
             out["slo"] = self.slo_monitor.status()
+        if self.tenant_board is not None:
+            out["tenants"] = self.tenant_board.status()
+        if self.timeseries is not None:
+            out["timeseries"] = self.timeseries.head()
         if self.varz_fn is not None:
             out.update(self.varz_fn())
         return out
@@ -249,7 +279,11 @@ def start_exporter(
     per-scrape registry (``router.merged_registry`` as the zero-arg
     provider), `fleet_health` on `/healthz` (503 only with no healthy
     replica), and per-replica detail on `/varz` (``router.varz``).
-    Returns the started server (read ``.port`` / ``.url``)."""
+    A `TimeSeriesStore` hung off the engine/router (its
+    ``timeseries=`` constructor arg) is picked up automatically for
+    `/timeseries` and the `/varz` head sample; pass ``timeseries=`` /
+    ``tenant_board=`` explicitly to override. Returns the started
+    server (read ``.port`` / ``.url``)."""
     if router is not None:
         if registry is None:
             registry = router.merged_registry
@@ -257,6 +291,13 @@ def start_exporter(
         kw.setdefault("varz_fn", router.varz)
     elif engine is not None and "health_fn" not in kw:
         kw["health_fn"] = engine_health(engine)
+    for owner in (router, engine):
+        if owner is None:
+            continue
+        ts = getattr(owner, "timeseries", None)
+        if ts is not None:
+            kw.setdefault("timeseries", ts)
+            break
     if registry is None:
         raise ValueError("pass a registry/provider, or router=...")
     return TelemetryServer(registry, port=port, **kw).start()

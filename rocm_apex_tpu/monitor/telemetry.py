@@ -43,6 +43,11 @@ the compiled programs (pinned by tools/graphlint.py fingerprints).
 `exposition()` renders the Prometheus text format (version 0.0.4)
 served by `monitor.exporter.TelemetryServer` at ``/metrics``; the SLO
 layer (`monitor.slo`) reads the same series to compute burn rates.
+`snapshot()` is the JSON-ready dump ``/varz`` serves — and the sample
+format `monitor.timeseries.TimeSeriesStore` rings up periodically to
+answer windowed rate/quantile queries (cumulative buckets differenced
+at the window edges interpolate with exactly the `Histogram.quantile`
+math, so windowed and cumulative percentiles share one error bound).
 See docs/observability.md "Telemetry & SLOs".
 """
 

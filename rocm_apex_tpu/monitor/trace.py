@@ -31,17 +31,62 @@ preallocated no-op context manager — call sites pay an attribute check
 (``tracer.enabled``), never an allocation, and the engine's compiled
 programs and host↔device fetch pattern are untouched (pinned by
 tests/L0/test_trace.py).
+
+**Fleet-causal tracing (ISSUE 19).** A fleet shatters one request's
+timeline across tracers: the router records `dispatch`, replica A the
+prefill, replica B (after a failover or a page-shipping handoff) the
+decode and the `finish`. Three pieces re-join them:
+
+* `mint_trace_id()` — the router stamps one process-unique trace id on
+  every admitted request; it rides every hop (migration records,
+  `resume_request` payloads, failover resubmission) and every
+  per-request tracer event carries it as an ``args`` field, so the
+  lifeline survives request-id reuse and engine boundaries;
+* `merge_traces([...])` — folds N tracers into ONE Chrome trace-event
+  body with a distinct ``pid`` (and ``process_name`` metadata) per
+  tracer and all timestamps renormalized onto a single clock zero
+  (every tracer reads the same ``perf_counter``), so Perfetto renders
+  a migrated request as one causally-ordered lifeline across replica
+  processes; `export_merged_trace(path, ...)` writes it;
+* exactly-once delivery becomes visually checkable: one ``finish``
+  event per trace id in the merged body (asserted by
+  `trace_lifelines`, the test/bench helper).
+
+**Runtime retrace sentinel (ISSUE 19).** Every serving PR swears "the
+mixed step traces once", but only graphlint checks it, statically. The
+`RetraceSentinel` subscribes to jax's own compilation events
+(`jax.monitoring`: the ``/jax/core/compile/*`` phase durations plus
+the ``/jax/compilation_cache/*`` events tests/conftest.py already
+counts), folds them into ``xla_compiles_total{phase=}`` registry
+counters, and — once `arm()`-ed at the warmup boundary — counts every
+post-warmup compile (`tripped`); with ``policy="raise"`` the owning
+engine/router raises `RetraceError` at the next tick. Compilation
+events are process-global, so one armed sentinel guards the whole
+fleet.
 """
 
+import itertools
 import json
+import os
 import threading
 import time
+import weakref
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 
-__all__ = ["Tracer", "NULL_TRACER"]
+__all__ = [
+    "Tracer",
+    "NULL_TRACER",
+    "mint_trace_id",
+    "merge_traces",
+    "export_merged_trace",
+    "trace_lifelines",
+    "RetraceSentinel",
+    "RetraceError",
+    "COMPILE_EVENT_PHASES",
+]
 
 
 class _NullSpan:
@@ -290,3 +335,349 @@ class Tracer:
 # The free default: share one disabled tracer so every call site can
 # hold a tracer unconditionally and pay only `tracer.enabled` checks.
 NULL_TRACER = Tracer(enabled=False, capacity=1)
+
+
+# ---------------------------------------------------------------------
+# fleet-causal trace context (ISSUE 19)
+# ---------------------------------------------------------------------
+
+_TRACE_SEQ = itertools.count()
+
+
+def mint_trace_id(prefix: str = "t") -> str:
+    """One process-unique trace id: ``<prefix><pid hex>-<seq hex>``.
+    The router mints one per ADMITTED request (not per attempt), so a
+    request that migrates, fails over, or hands off keeps the same id
+    across every replica that touches it — the join key
+    `merge_traces` timelines group on. Monotonic within a process;
+    the pid component keeps multi-process fleets collision-free."""
+    return f"{prefix}{os.getpid():x}-{next(_TRACE_SEQ):x}"
+
+
+def merge_traces(
+    tracers: Sequence[Tracer],
+    labels: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """Fold N tracers into ONE Chrome trace-event body: tracer ``i``
+    becomes process ``pid=i+1`` (named ``labels[i]``, default
+    ``tracer<i>``), its tracks keep their per-process thread ids
+    (namespaced by the pid — Perfetto scopes tids per process), and
+    every timestamp is renormalized onto a single clock zero (the
+    earliest tracer's creation time; all tracers read the same
+    ``time.perf_counter``, so absolute event times are directly
+    comparable). A request that hopped replicas renders as one
+    left-to-right causal lifeline: ``dispatch`` on the router process,
+    ``resume``/spans on each replica process it visited, exactly one
+    ``finish`` — grouped by the ``trace_id`` event arg.
+
+    Returns the loadable JSON body (``traceEvents`` +
+    ``displayTimeUnit`` + ``otherData``); `export_merged_trace`
+    writes it to disk."""
+    tracers = list(tracers)
+    if not tracers:
+        raise ValueError("merge_traces needs at least one tracer")
+    if labels is None:
+        labels = [f"tracer{i}" for i in range(len(tracers))]
+    labels = [str(x) for x in labels]
+    if len(labels) != len(tracers):
+        raise ValueError(
+            f"{len(labels)} labels for {len(tracers)} tracers"
+        )
+    t0 = min(tr._t0 for tr in tracers)
+    events: List[Dict[str, Any]] = []
+    dropped = 0
+    for i, (tr, label) in enumerate(zip(tracers, labels)):
+        pid = i + 1
+        with tr._lock:
+            snap = list(tr._events)
+            tracks = dict(tr._tracks)
+        dropped += tr._dropped
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+        events.append({
+            "ph": "M", "name": "process_sort_index", "pid": pid,
+            "tid": 0, "args": {"sort_index": i},
+        })
+        for track, tid in tracks.items():
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid,
+                "tid": tid, "args": {"name": track},
+            })
+            events.append({
+                "ph": "M", "name": "thread_sort_index", "pid": pid,
+                "tid": tid, "args": {"sort_index": tid},
+            })
+        for ph, name, tid, ts, dur, args in snap:
+            ev: Dict[str, Any] = {
+                "ph": ph, "name": name, "pid": pid, "tid": tid,
+                "ts": round((ts - t0) * 1e6, 3),
+            }
+            if ph == "X":
+                ev["dur"] = round(dur * 1e6, 3)
+            else:
+                ev["s"] = "t"
+            if args:
+                ev["args"] = args
+            events.append(ev)
+    other: Dict[str, Any] = {
+        "producer": "rocm_apex_tpu.monitor.trace.merge_traces",
+        "processes": {
+            str(i + 1): label for i, label in enumerate(labels)
+        },
+        "dropped_events": dropped,
+    }
+    if dropped:
+        other["warning"] = (
+            f"{dropped} events dropped by ring-buffer wrap across the "
+            f"merged tracers; some lifelines are incomplete"
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": other,
+    }
+
+
+def export_merged_trace(
+    path: str,
+    tracers: Sequence[Tracer],
+    labels: Optional[Sequence[str]] = None,
+) -> int:
+    """`merge_traces` to disk (Perfetto-loadable); returns the event
+    count, metadata included."""
+    body = merge_traces(tracers, labels)
+    with open(path, "w") as f:
+        json.dump(body, f)
+    return len(body["traceEvents"])
+
+
+def trace_lifelines(
+    body: Dict[str, Any],
+) -> Dict[str, Dict[str, Any]]:
+    """Group a merged (or single-tracer) trace body by ``trace_id``:
+    ``{trace_id: {"pids": sorted pids touched, "events": count,
+    "finishes": count of finish events, "names": sorted event
+    names}}``. The exactly-once acceptance reads directly off it —
+    every lifeline must show ``finishes == 1``, and a migrated
+    request's ``pids`` spans more than one process."""
+    lifelines: Dict[str, Dict[str, Any]] = {}
+    for ev in body.get("traceEvents", ()):
+        tid_ = (ev.get("args") or {}).get("trace_id")
+        if not tid_:
+            continue
+        line = lifelines.setdefault(
+            tid_, {"pids": set(), "events": 0, "finishes": 0,
+                   "names": set()},
+        )
+        line["pids"].add(ev.get("pid", 1))
+        line["events"] += 1
+        line["names"].add(ev["name"])
+        if ev["name"] == "finish":
+            line["finishes"] += 1
+    for line in lifelines.values():
+        line["pids"] = sorted(line["pids"])
+        line["names"] = sorted(line["names"])
+    return lifelines
+
+
+# ---------------------------------------------------------------------
+# runtime retrace sentinel (ISSUE 19)
+# ---------------------------------------------------------------------
+
+#: jax.monitoring event -> the compile phase it witnesses. The
+#: ``/jax/core/compile/*`` durations fire on EVERY jit trace/lower/
+#: backend-compile regardless of cache configuration; the
+#: ``/jax/compilation_cache/*`` events additionally fire when the
+#: persistent compilation cache is enabled (the same substrate
+#: tests/conftest.py counts hit ratios from).
+COMPILE_EVENT_PHASES: Dict[str, str] = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "compile",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "cache_request",
+    "/jax/compilation_cache/cache_hits": "cache_hit",
+    "/jax/compilation_cache/cache_misses": "cache_miss",
+}
+
+
+class RetraceError(RuntimeError):
+    """A compile landed after the warmup boundary on a sentinel with
+    ``policy="raise"`` — some input shape, dtype, or closure drifted
+    and XLA re-traced (the latency cliff the one-compiled-trace
+    invariant exists to prevent)."""
+
+
+# One process-wide pair of jax.monitoring listeners fanning out to the
+# live sentinels. jax has no public unregister, so registering per
+# sentinel would grow the dispatch list forever; the WeakSet lets
+# short-lived sentinels (tests, benches) vanish with their owners.
+_SENTINELS: "weakref.WeakSet" = weakref.WeakSet()
+_LISTENERS_INSTALLED = False
+_INSTALL_LOCK = threading.Lock()
+
+
+def _dispatch_compile_event(event: str, **kwargs) -> None:
+    phase = COMPILE_EVENT_PHASES.get(event)
+    if phase is None:
+        return
+    for sentinel in list(_SENTINELS):
+        sentinel._note(phase)
+
+
+def _dispatch_compile_duration(
+    event: str, duration: float, **kwargs
+) -> None:
+    _dispatch_compile_event(event)
+
+
+def _install_listeners() -> None:
+    global _LISTENERS_INSTALLED
+    with _INSTALL_LOCK:
+        if _LISTENERS_INSTALLED:
+            return
+        import jax.monitoring as jax_monitoring
+
+        jax_monitoring.register_event_listener(_dispatch_compile_event)
+        jax_monitoring.register_event_duration_secs_listener(
+            _dispatch_compile_duration
+        )
+        _LISTENERS_INSTALLED = True
+
+
+class RetraceSentinel:
+    """Continuous enforcement of "the fleet compiles once".
+
+    Counts every jax compilation event by phase (`counts`; into
+    ``xla_compiles_total{phase=}`` when a registry is attached). After
+    `arm()` — the warmup boundary; `InferenceEngine.reset_stats()`
+    arms its sentinel because that IS the bench contract's
+    warmed-up-now marker — post-warmup events additionally land in
+    `post_warmup` (and ``xla_compiles_post_warmup_total{phase=}``),
+    and phases in ``trip_phases`` (default: a fresh jaxpr trace or a
+    backend compile — cache hits don't trip; re-checking the
+    persistent cache is cheap, re-tracing is the cliff) accumulate
+    into `tripped` and emit a ``retrace`` tracer instant.
+
+    ``policy="count"`` observes; ``policy="raise"`` makes `check()` —
+    called by the owning engine/router once per tick, NOT from inside
+    the jax callback where an exception would surface mid-compile —
+    raise `RetraceError`. Events are process-global: any compile
+    anywhere in the process counts, which is exactly the property
+    that lets one router-held sentinel guard N replicas."""
+
+    def __init__(
+        self,
+        registry=None,
+        *,
+        policy: str = "count",
+        tracer: Optional[Tracer] = None,
+        trip_phases: Sequence[str] = ("trace", "compile"),
+    ):
+        if policy not in ("count", "raise"):
+            raise ValueError(
+                f"retrace policy must be 'count' or 'raise', "
+                f"got {policy!r}"
+            )
+        self.policy = policy
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.trip_phases = frozenset(str(p) for p in trip_phases)
+        unknown = self.trip_phases - set(COMPILE_EVENT_PHASES.values())
+        if unknown:
+            raise ValueError(
+                f"unknown trip phases {sorted(unknown)}; phases are "
+                f"{sorted(set(COMPILE_EVENT_PHASES.values()))}"
+            )
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {}
+        self.post_warmup: Dict[str, int] = {}
+        self.armed = False
+        self._counter = None
+        self._post_counter = None
+        if registry is not None and registry.enabled:
+            self._counter = registry.counter(
+                "xla_compiles_total",
+                "jax compilation events by phase (trace/lower/compile "
+                "+ the persistent-cache request/hit/miss events).",
+                labelnames=("phase",),
+            )
+            self._post_counter = registry.counter(
+                "xla_compiles_post_warmup_total",
+                "Compilation events AFTER the sentinel was armed — "
+                "nonzero means something re-traced in the serving "
+                "window.",
+                labelnames=("phase",),
+            )
+        _install_listeners()
+        _SENTINELS.add(self)
+
+    # invoked from the module-level jax.monitoring fan-out
+    def _note(self, phase: str) -> None:
+        with self._lock:
+            self.counts[phase] = self.counts.get(phase, 0) + 1
+            if self._counter is not None:
+                self._counter.inc(phase=phase)
+            if not self.armed:
+                return
+            self.post_warmup[phase] = (
+                self.post_warmup.get(phase, 0) + 1
+            )
+            if self._post_counter is not None:
+                self._post_counter.inc(phase=phase)
+        if self.tracer.enabled and phase in self.trip_phases:
+            self.tracer.instant(
+                "retrace", track="sentinel", phase=phase,
+            )
+
+    def arm(self) -> None:
+        """Mark the warmup boundary: compiles from here on are
+        retraces."""
+        self.armed = True
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    @property
+    def tripped(self) -> int:
+        """Post-warmup events in the trip phases (0 = the invariant
+        held)."""
+        with self._lock:
+            return sum(
+                n for p, n in self.post_warmup.items()
+                if p in self.trip_phases
+            )
+
+    def check(self) -> int:
+        """Tick-boundary enforcement point: returns `tripped`, raising
+        `RetraceError` under ``policy="raise"`` when nonzero."""
+        n = self.tripped
+        if n and self.policy == "raise":
+            with self._lock:
+                detail = dict(self.post_warmup)
+            raise RetraceError(
+                f"{n} compilation event(s) landed after warmup "
+                f"(post-warmup by phase: {detail}) — the "
+                f"one-compiled-trace invariant broke at runtime"
+            )
+        return n
+
+    def close(self) -> None:
+        """Drop out of the process-wide dispatch (also implicit on
+        GC)."""
+        _SENTINELS.discard(self)
+
+    def status(self) -> Dict[str, Any]:
+        """JSON-ready dump for ``/varz``."""
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "armed": self.armed,
+                "tripped": sum(
+                    n for p, n in self.post_warmup.items()
+                    if p in self.trip_phases
+                ),
+                "counts": dict(self.counts),
+                "post_warmup": dict(self.post_warmup),
+            }

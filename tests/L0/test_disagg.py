@@ -297,6 +297,41 @@ class TestReplicaClasses:
         assert "router_tpot_ms" in text
         assert 'replica_class="decode"' in text
 
+    def test_handoff_trace_continuity(self, model_and_params):
+        """ISSUE-19 fleet-causal acceptance on the handoff path: a
+        prompt prefilled on the prefill replica and decoded on the
+        decode replica is ONE trace_id lifeline spanning both replica
+        processes, finished exactly once, with the router's handoff
+        instant carrying the same join key."""
+        from rocm_apex_tpu.monitor.trace import Tracer, trace_lifelines
+
+        model, params = model_and_params
+        dis = ReplicaRouter(
+            model, params, replicas=2, engine_kwargs=dict(EKW),
+            replica_classes=["prefill", "decode"],
+            tracer=Tracer(),
+        )
+        for i in range(2):
+            dis.replica(i).tracer = Tracer()
+        dis.generate(FLEET_PROMPTS, max_new_tokens=MAX_NEW)
+        st = dis.stats()
+        assert st["handoffs"] >= 1, st
+        body = dis.merged_trace()
+        assert body["otherData"]["processes"]["2"] == "replica0:prefill"
+        assert body["otherData"]["processes"]["3"] == "replica1:decode"
+        lines = trace_lifelines(body)
+        assert len(lines) == len(FLEET_PROMPTS)
+        assert all(l["finishes"] == 1 for l in lines.values()), lines
+        handoff_ids = {
+            e["args"]["trace_id"] for e in body["traceEvents"]
+            if e.get("ph") == "i" and e["name"] == "handoff"
+        }
+        assert len(handoff_ids) >= 1
+        for tid in handoff_ids:
+            # prefilled on pid 2, decoded (and finished) on pid 3
+            assert lines[tid]["pids"] == [1, 2, 3], lines[tid]
+            assert "finish" in lines[tid]["names"]
+
     def test_class_validation(self, model_and_params):
         model, params = model_and_params
         with pytest.raises(ValueError, match="decode"):

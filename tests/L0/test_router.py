@@ -40,6 +40,7 @@ from rocm_apex_tpu.inference import (
 from rocm_apex_tpu.models.gpt import GPTConfig, GPTModel
 from rocm_apex_tpu.monitor import start_exporter
 from rocm_apex_tpu.monitor.telemetry import MetricRegistry
+from rocm_apex_tpu.monitor.trace import Tracer, trace_lifelines
 
 
 def fp32_cfg(**kw):
@@ -348,6 +349,60 @@ def test_kill_paged_no_page_leak(model_and_params, paged_env):
         rep = router.replica(i)
         assert rep.pages_used == 0, f"replica {i} leaked pages"
         rep._allocator.assert_consistent()
+
+
+def test_kill_mid_decode_trace_continuity(model_and_params, contig_env):
+    """ISSUE-19 fleet-causal acceptance on the failover path: a
+    request killed mid-decode keeps its admission-minted trace_id
+    across the resubmission, so the merged fleet trace renders it as
+    ONE lifeline spanning BOTH replica processes with exactly one
+    finish — and the kill instant names the recovered ids."""
+    model, params = model_and_params
+    donor, contig_ref = contig_env
+    plan = FaultPlan(
+        [Fault(site="replica_kill", tick=4, payload={"replica": 0})],
+        seed=0,
+    )
+    router = build_router(
+        model, params, donor, faults=plan, tracer=Tracer()
+    )
+    for i in range(router.num_replicas):
+        router.replica(i).tracer = Tracer()  # one process id each
+    for p in PROMPTS:
+        router.add_request(p, MAX_NEW)
+    done = run_to_done(router)
+    assert plan.fires.get("replica_kill") == 1
+    assert_parity([done[i] for i in sorted(done)], contig_ref, MAX_NEW)
+    body = router.merged_trace()
+    # default labels: the router first, then replica<i>:<class>
+    assert body["otherData"]["processes"]["1"] == "router"
+    assert body["otherData"]["processes"]["2"] == "replica0:mixed"
+    lines = trace_lifelines(body)
+    assert len(lines) == len(PROMPTS)
+    for tid, line in lines.items():
+        assert line["finishes"] == 1, (tid, line)
+        assert 1 in line["pids"], (tid, line)  # admitted on the router
+    # the kill migrated at least one in-flight request: its lifeline
+    # spans the victim AND the survivor processes
+    migrated = [
+        line for line in lines.values()
+        if len([p for p in line["pids"] if p > 1]) > 1
+    ]
+    assert migrated, lines
+    assert any(2 in m["pids"] and 3 in m["pids"] for m in migrated)
+    # the router's kill instant names what it recovered (the trace_id
+    # join keys ride the fleet event, not just the per-request tracks)
+    kills = [
+        e for e in body["traceEvents"]
+        if e.get("ph") == "i" and e["name"] == "kill_replica"
+    ]
+    assert len(kills) == 1
+    recovered = kills[0]["args"]["trace_ids"]
+    assert recovered and all(t in lines for t in recovered)
+    # every lifeline shows the admit -> dispatch -> ... -> finish arc
+    for line in lines.values():
+        assert "admit" in line["names"]
+        assert "dispatch" in line["names"]
 
 
 def test_fault_plan_replay(model_and_params, contig_donor):

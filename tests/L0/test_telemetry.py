@@ -348,6 +348,69 @@ class TestExporter:
             assert status == 404
         srv.close()  # idempotent
 
+    def test_timeseries_endpoint_and_varz_head(self):
+        """ISSUE-19 sensor-plane surface: with a `TimeSeriesStore`
+        attached, ``/timeseries`` serves the windowed series body,
+        ``/varz`` carries its head sample plus the tenant board's
+        status; without one, ``/timeseries`` answers 404."""
+        from rocm_apex_tpu.monitor import TenantSLOBoard, TimeSeriesStore
+
+        reg = MetricRegistry()
+        c = reg.counter("reqs_total")
+        h = reg.histogram(
+            "serve_ttft_ms", labelnames=("tenant",),
+            buckets=(1.0, 10.0),
+        )
+        board = TenantSLOBoard(h, registry=reg)
+        board.ensure("acme")
+        clock = iter(float(i) for i in range(100))
+        ts = TimeSeriesStore(reg, interval=1.0, clock=lambda: next(clock))
+        for n in (1, 2, 4):
+            for _ in range(n):
+                c.inc()
+                h.observe(3.0, tenant="acme")
+            ts.sample()
+        with TelemetryServer(reg, timeseries=ts, tenant_board=board) as srv:
+            status, ctype, body = self._get(srv.port, "/timeseries")
+            assert status == 200 and ctype == "application/json"
+            series = json.loads(body)
+            assert series["series"]["reqs_total"]["total"] == [
+                1.0, 3.0, 7.0,
+            ]
+            assert len(series["t"]) == len(ts) == 3
+            assert "p95" in series["series"]["serve_ttft_ms"]
+            status, _, body = self._get(srv.port, "/varz")
+            varz = json.loads(body)
+            # the head sample and the tenant board ride /varz
+            assert varz["timeseries"]["samples"] == 3
+            assert varz["timeseries"]["rates_per_s"]["reqs_total"] == 4.0
+            assert "acme" in varz["tenants"]
+        with TelemetryServer(reg) as srv:
+            status, _, body = self._get(srv.port, "/timeseries")
+            assert status == 404 and b"no timeseries" in body
+
+    def test_start_exporter_picks_up_owner_timeseries(self):
+        """`start_exporter(engine=...)` auto-wires the engine's
+        attached `TimeSeriesStore` for /timeseries, matching the
+        router path bench.py uses."""
+        from rocm_apex_tpu.monitor import TimeSeriesStore, start_exporter
+
+        class _Owner:
+            pass
+
+        reg = MetricRegistry()
+        reg.counter("ticks_total").inc()
+        owner = _Owner()
+        owner.timeseries = TimeSeriesStore(reg, interval=1.0)
+        owner.timeseries.sample()
+        srv = start_exporter(reg, engine=owner)
+        try:
+            status, _, body = self._get(srv.port, "/timeseries")
+            assert status == 200
+            assert "ticks_total" in json.loads(body)["series"]
+        finally:
+            srv.close()
+
 
 # ---------------------------------------------------------------------------
 # SLO burn rates (synthetic clock — no wall time)

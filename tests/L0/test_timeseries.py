@@ -1,0 +1,206 @@
+"""Time-series sensor plane (`monitor/timeseries.py`, ISSUE 19).
+
+The acceptance bars under test, all host-only (zero jax programs):
+
+* `TimeSeriesStore` keeps a FIXED-memory ring of periodic registry
+  snapshots — `tick()` samples only when the interval elapsed, the
+  ring wraps at ``capacity`` and counts what it dropped;
+* the windowed queries are CONSISTENT with the cumulative counters:
+  `delta` over the full ring reproduces the counter increase, `rate`
+  divides by the actual edge-sample span, `quantile_over` differences
+  cumulative histogram buckets at the window edges and interpolates
+  with the exact `Histogram.quantile` arithmetic;
+* the sensor sees a load change BEFORE the cumulative average moves —
+  the windowed rate over a burst exceeds the full-run average while
+  the cumulative counter alone cannot say when the burst happened;
+* `head()` / `series_json()` are the JSON surfaces ``/varz`` and
+  ``/timeseries`` serve.
+
+Clocks are injected everywhere (``clock=`` / ``tick(now=)``), so every
+assertion is exact — no sleeps, no wall-clock flake.
+"""
+
+import pytest
+
+from rocm_apex_tpu.monitor import MetricRegistry, TimeSeriesStore
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_plane(interval=1.0, capacity=600):
+    reg = MetricRegistry()
+    clock = FakeClock()
+    ts = TimeSeriesStore(
+        reg, interval=interval, capacity=capacity, clock=clock
+    )
+    c = reg.counter("reqs_total", "requests", labelnames=("tenant",))
+    g = reg.gauge("queue_depth", "queued")
+    h = reg.histogram(
+        "ttft_ms", "latency", buckets=[1.0, 2.0, 4.0, 8.0, 16.0]
+    )
+    return reg, clock, ts, c, g, h
+
+
+class TestSampling:
+    def test_tick_samples_at_interval_only(self):
+        _, clock, ts, c, _, _ = make_plane(interval=1.0)
+        assert ts.tick() is True  # first tick always samples
+        c.inc(tenant="a")
+        assert ts.tick() is False  # same instant: inside the interval
+        clock.advance(0.5)
+        assert ts.tick() is False
+        clock.advance(0.6)
+        assert ts.tick() is True
+        assert len(ts) == 2
+
+    def test_ring_is_bounded_and_counts_drops(self):
+        _, clock, ts, c, _, _ = make_plane(interval=1.0, capacity=4)
+        for _ in range(10):
+            c.inc(tenant="a")
+            ts.tick()
+            clock.advance(1.0)
+        assert len(ts) == 4
+        assert ts.dropped == 6
+
+    def test_validation(self):
+        reg = MetricRegistry()
+        with pytest.raises(ValueError, match="interval"):
+            TimeSeriesStore(reg, interval=0.0)
+        with pytest.raises(ValueError, match="capacity"):
+            TimeSeriesStore(reg, capacity=1)
+
+    def test_queries_empty_until_two_samples(self):
+        _, clock, ts, c, _, _ = make_plane()
+        assert ts.delta("reqs_total") == 0.0
+        ts.tick()
+        assert ts.rate("reqs_total") == 0.0
+        assert ts.quantile_over("ttft_ms", 0.5) == 0.0
+
+
+class TestWindowedQueries:
+    def _accelerating_load(self):
+        """1, 2, 3, 4 arrivals in four consecutive 1s intervals: the
+        doubling-and-then-some ramp the sensor plane must see."""
+        reg, clock, ts, c, g, h = make_plane(interval=1.0)
+        ts.tick()  # t=0, totals all zero
+        for n in (1, 2, 3, 4):
+            for _ in range(n):
+                c.inc(tenant="a")
+                h.observe(2.0 * n)  # latency grows with load
+            g.set(float(n))
+            clock.advance(1.0)
+            ts.tick()
+        return reg, clock, ts
+
+    def test_delta_and_rate_full_window_match_cumulative(self):
+        reg, _, ts = self._accelerating_load()
+        # full ring: first sample held 0, the counter now reads 10 —
+        # the windowed view and the cumulative counter agree exactly
+        assert ts.delta("reqs_total") == 10.0
+        assert ts.rate("reqs_total") == pytest.approx(10.0 / 4.0)
+
+    def test_burst_window_rate_leads_cumulative_average(self):
+        _, _, ts = self._accelerating_load()
+        # last 1s window saw 4 arrivals; the cumulative average is
+        # still 2.5/s — the sensor moves first
+        assert ts.rate("reqs_total", window=1.0) == pytest.approx(4.0)
+        assert ts.rate("reqs_total") == pytest.approx(2.5)
+
+    def test_label_filter(self):
+        _, clock, ts, c, _, _ = make_plane()
+        ts.tick()
+        c.inc(tenant="a")
+        c.inc(tenant="a")
+        c.inc(tenant="b")
+        clock.advance(1.0)
+        ts.tick()
+        assert ts.delta("reqs_total", labels={"tenant": "a"}) == 2.0
+        assert ts.delta("reqs_total", labels={"tenant": "b"}) == 1.0
+        assert ts.delta("reqs_total") == 3.0  # no filter: aggregate
+
+    def test_quantile_over_uses_window_observations_only(self):
+        reg, _, ts = self._accelerating_load()
+        # last window: 4 observations at 8.0, in the (4, 8] bucket —
+        # target 2.0 of 4 interpolates to 6.0 (lo 4 + 0.5 * (8 - 4))
+        assert ts.quantile_over(
+            "ttft_ms", 0.5, window=1.0
+        ) == pytest.approx(6.0)
+        # full window blends the cheap early observations back in and
+        # reads lower — and matches the cumulative histogram exactly,
+        # because the first sample's buckets were all zero
+        q_full = ts.quantile_over("ttft_ms", 0.5)
+        assert q_full < 6.0
+        assert q_full == pytest.approx(
+            reg.get("ttft_ms").quantile(0.5)
+        )
+
+    def test_counter_reset_clamps_to_zero(self):
+        _, clock, ts, c, _, _ = make_plane()
+        c.inc(tenant="a")
+        c.inc(tenant="a")
+        ts.tick()
+        clock.advance(1.0)
+        ts.sample()  # ring: [2, 2]
+        # a fresh registry snapshot after reset would read lower;
+        # simulate by sampling a smaller registry state
+        ts._samples.append((clock.advance(1.0), {
+            "reqs_total": {
+                "type": "counter",
+                "series": [{"labels": {"tenant": "a"}, "value": 1.0}],
+            },
+        }))
+        assert ts.delta("reqs_total") == 0.0
+        assert ts.rate("reqs_total") == 0.0
+
+    def test_gauge_over_min_mean_max(self):
+        _, _, ts = self._accelerating_load()
+        stats = ts.gauge_over("queue_depth")
+        assert stats["max"] == 4.0 and stats["min"] == 0.0
+        assert stats["samples"] == 5
+        recent = ts.gauge_over("queue_depth", window=1.0)
+        assert recent["min"] >= 3.0
+
+
+class TestExportSurfaces:
+    def test_head_summary(self):
+        _, _, ts = self._load()
+        head = ts.head()
+        assert head["samples"] == len(ts)
+        assert head["interval_s"] == 1.0
+        assert head["rates_per_s"]["reqs_total"] == pytest.approx(4.0)
+        assert head["gauges"]["queue_depth"] == 4.0
+
+    def test_series_json_shape_and_consistency(self):
+        _, _, ts = self._load()
+        body = ts.series_json()
+        assert len(body["t"]) == len(ts)
+        reqs = body["series"]["reqs_total"]
+        assert reqs["total"] == [0.0, 1.0, 3.0, 6.0, 10.0]
+        assert reqs["rate_per_s"] == [0.0, 1.0, 2.0, 3.0, 4.0]
+        ttft = body["series"]["ttft_ms"]
+        assert len(ttft["p95"]) == len(ts)
+        gauge = body["series"]["queue_depth"]
+        assert gauge["total"][-1] == 4.0
+        assert "rate_per_s" not in gauge
+
+    def _load(self):
+        reg, clock, ts, c, g, h = make_plane(interval=1.0)
+        ts.tick()
+        for n in (1, 2, 3, 4):
+            for _ in range(n):
+                c.inc(tenant="a")
+                h.observe(2.0 * n)
+            g.set(float(n))
+            clock.advance(1.0)
+            ts.tick()
+        return reg, clock, ts

@@ -40,11 +40,17 @@ from rocm_apex_tpu.monitor import (
     FlightRecorder,
     JsonlWriter,
     Metrics,
+    MetricRegistry,
+    RetraceError,
+    RetraceSentinel,
     Tracer,
     audit,
     group_nonfinite,
+    merge_traces,
+    mint_trace_id,
+    trace_lifelines,
 )
-from rocm_apex_tpu.monitor.trace import _NULL_SPAN
+from rocm_apex_tpu.monitor.trace import _NULL_SPAN, export_merged_trace
 
 
 def _mesh(n):
@@ -294,6 +300,177 @@ class TestServingTimelines:
         assert "prefill_chunk" not in names
         (rec,) = eng.completions
         assert rec["chunks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet-causal tracing: merge_traces / trace_lifelines (host-only)
+# ---------------------------------------------------------------------------
+
+
+class TestMergeTraces:
+    def _fleet(self):
+        """Hand-built three-tracer fleet: a request admitted on the
+        router, prefilled on replica 0, migrated, finished on replica
+        1 — the hop shape the real router/engine pair emits."""
+        import time
+
+        router, rep0, rep1 = Tracer(), Tracer(), Tracer()
+        tid = mint_trace_id()
+        t = time.perf_counter()
+        router.instant("admit", ts=t, track="req0",
+                       request_id=0, trace_id=tid)
+        router.instant("dispatch", ts=t + 0.001, track="req0",
+                       request_id=0, trace_id=tid)
+        rep0.instant("resume", ts=t + 0.002, track="req0",
+                     request_id=0, trace_id=tid)
+        rep0.add_span("prefill_chunk", t + 0.002, t + 0.004,
+                      track="req0", tokens=4, trace_id=tid)
+        router.instant("migrate", ts=t + 0.005, track="req0",
+                       request_id=0, trace_id=tid)
+        rep1.instant("resume", ts=t + 0.006, track="req0",
+                     request_id=0, trace_id=tid)
+        rep1.instant("finish", ts=t + 0.009, track="req0",
+                     request_id=0, trace_id=tid)
+        return [router, rep0, rep1], tid
+
+    def test_pids_labels_and_renormalized_clock(self):
+        tracers, _ = self._fleet()
+        body = merge_traces(tracers, labels=["router", "r0", "r1"])
+        procs = {
+            e["pid"]: e["args"]["name"]
+            for e in body["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert procs == {1: "router", 2: "r0", 3: "r1"}
+        assert body["otherData"]["processes"] == {
+            "1": "router", "2": "r0", "3": "r1",
+        }
+        data = [
+            e for e in body["traceEvents"] if e["ph"] in ("X", "i")
+        ]
+        # one common clock zero: every event lands at a nonnegative
+        # offset, and cross-process ordering is preserved (the router
+        # admit precedes the replica-1 finish)
+        assert all(e["ts"] >= 0.0 for e in data)
+        by_name = {(e["pid"], e["name"]): e["ts"] for e in data}
+        assert by_name[(1, "admit")] < by_name[(3, "finish")]
+        assert by_name[(2, "resume")] < by_name[(3, "resume")]
+
+    def test_lifelines_exactly_one_finish_across_pids(self):
+        tracers, tid = self._fleet()
+        lines = trace_lifelines(merge_traces(tracers))
+        assert set(lines) == {tid}
+        line = lines[tid]
+        assert line["pids"] == [1, 2, 3]
+        assert line["finishes"] == 1
+        assert "admit" in line["names"] and "migrate" in line["names"]
+        assert line["events"] == 7
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="at least one"):
+            merge_traces([])
+        with pytest.raises(ValueError, match="labels"):
+            merge_traces([Tracer(), Tracer()], labels=["only-one"])
+
+    def test_export_is_valid_json(self, tmp_path):
+        tracers, tid = self._fleet()
+        path = tmp_path / "fleet.json"
+        n = export_merged_trace(str(path), tracers)
+        body = json.loads(path.read_text())
+        assert len(body["traceEvents"]) == n
+        assert trace_lifelines(body)[tid]["finishes"] == 1
+
+    def test_mint_trace_id_unique_and_prefixed(self):
+        ids = {mint_trace_id() for _ in range(100)}
+        assert len(ids) == 100
+        assert all(i.startswith("t") for i in ids)
+        assert mint_trace_id(prefix="q").startswith("q")
+
+
+# ---------------------------------------------------------------------------
+# runtime retrace sentinel (one tiny fresh jit per compile probe)
+# ---------------------------------------------------------------------------
+
+
+def _fresh_compile(offset):
+    """Force one real compilation event: a brand-new lambda is a new
+    jit cache entry, so jax traces (and compiles) it from scratch."""
+    jax.jit(lambda x: x + offset).lower(
+        jnp.ones((3,), jnp.float32)
+    ).compile()
+
+
+class TestRetraceSentinel:
+    def test_counts_then_trips_after_arm(self):
+        reg = MetricRegistry()
+        s = RetraceSentinel(reg)
+        try:
+            _fresh_compile(1.0)
+            assert s.counts.get("trace", 0) >= 1
+            assert s.tripped == 0 and s.check() == 0  # not armed yet
+            s.arm()
+            _fresh_compile(2.0)
+            assert s.tripped >= 1
+            assert s.check() == s.tripped  # count policy: no raise
+            # both registry families moved with the dict counters
+            snap = reg.snapshot()
+            total = sum(
+                x["value"]
+                for x in snap["xla_compiles_total"]["series"]
+            )
+            post = sum(
+                x["value"]
+                for x in snap["xla_compiles_post_warmup_total"]["series"]
+            )
+            assert total >= post >= 1
+        finally:
+            s.close()
+
+    def test_raise_policy_fails_the_next_check(self):
+        s = RetraceSentinel(policy="raise")
+        try:
+            s.arm()
+            _fresh_compile(3.0)
+            with pytest.raises(RetraceError, match="after warmup"):
+                s.check()
+            s.disarm()
+        finally:
+            s.close()
+
+    def test_closed_sentinel_stops_counting(self):
+        s = RetraceSentinel()
+        s.arm()
+        s.close()
+        before = s.tripped
+        _fresh_compile(4.0)
+        assert s.tripped == before
+
+    def test_tracer_instant_on_trip(self):
+        tr = Tracer()
+        s = RetraceSentinel(tracer=tr)
+        try:
+            s.arm()
+            _fresh_compile(5.0)
+        finally:
+            s.close()
+        hits = [
+            e for e in tr.events()
+            if e["ph"] == "i" and e["name"] == "retrace"
+        ]
+        assert hits and hits[0]["args"]["phase"] in ("trace", "compile")
+
+    def test_validation_and_status(self):
+        with pytest.raises(ValueError, match="policy"):
+            RetraceSentinel(policy="explode")
+        with pytest.raises(ValueError, match="trip phases"):
+            RetraceSentinel(trip_phases=("warp",))
+        s = RetraceSentinel()
+        try:
+            st = s.status()
+            assert st["policy"] == "count" and st["armed"] is False
+            assert st["tripped"] == 0
+        finally:
+            s.close()
 
 
 # ---------------------------------------------------------------------------

@@ -27,6 +27,12 @@ read-back:
   appearances. Rounds that didn't run a guarded bench don't trip the
   gate (the diff pairs the last two rounds that DID); ``--warn-only``
   downgrades the failure to a warning for exploratory rounds.
+* CEILING guards invert the direction for lower-is-better metrics:
+  ``gpt_serve_retrace_sentinel`` (post-warmup XLA compiles counted by
+  the armed retrace sentinel across the chaos-composed disagg pass)
+  must read 0.0 in its newest appearance — ANY positive value fails
+  the gate immediately, threshold and round pairing notwithstanding
+  (one retrace is already the latency cliff the invariant forbids).
 
 Usage (from the repo root, part of the tier-1 flow in ROADMAP.md):
 
@@ -46,7 +52,15 @@ DEFAULT_GUARDS = (
     "gpt_serve_tokens_per_sec_per_chip_tp2",
     "gpt_serve_tokens_per_sec_per_chip_disagg",
     "gpt_serve_adapter_tokens_per_sec_per_chip",
+    "gpt_serve_retrace_sentinel",
 )
+
+#: lower-is-better guards gated against a hard ceiling instead of a
+#: round-over-round drop: the newest appearance must not exceed the
+#: ceiling (the retrace sentinel's healthy reading is exactly zero)
+CEILING_GUARDS = {
+    "gpt_serve_retrace_sentinel": 0.0,
+}
 
 
 def load_rounds(bench_dir):
@@ -148,6 +162,22 @@ def main(argv=None):
 
     failed = []
     for metric in guards:
+        ceiling = CEILING_GUARDS.get(metric)
+        if ceiling is not None:
+            hits = [(n, m[metric]) for n, m in rounds if metric in m]
+            if not hits:
+                print(f"guard {metric}: no appearances — nothing to gate")
+                continue
+            n1, v1 = hits[-1]
+            status = "ok"
+            if v1 > ceiling:
+                status = "REGRESSION"
+                failed.append((metric, n1, n1, v1 - ceiling))
+            print(
+                f"guard {metric}: r{n1:02d} {v1:.1f} "
+                f"(ceiling {ceiling:.1f}) {status}"
+            )
+            continue
         pair = last_two(rounds, metric)
         if pair is None:
             print(f"guard {metric}: <2 appearances — nothing to diff")
@@ -164,6 +194,15 @@ def main(argv=None):
         )
     if failed:
         for metric, n0, n1, delta in failed:
+            if metric in CEILING_GUARDS:
+                ceiling = CEILING_GUARDS[metric]
+                print(
+                    f"bench_history: {metric} read "
+                    f"{ceiling + delta:.1f} in r{n1:02d}, above its "
+                    f"{ceiling:.1f} ceiling",
+                    file=sys.stderr,
+                )
+                continue
             print(
                 f"bench_history: {metric} regressed {delta:.1%} "
                 f"(r{n0:02d} -> r{n1:02d}, threshold "
